@@ -1,0 +1,34 @@
+"""mpitest_tpu — a TPU-native distributed sorting framework.
+
+A ground-up re-design of the capabilities of the reference MPI teaching repo
+(``acgrid/mpi-test``: revised sample sort + LSD radix sort of integer keys,
+SPMD over P workers) for TPU hardware:
+
+* keys live **device-resident and sharded** over a 1-D ``jax.sharding.Mesh``
+  (the reference round-trips through rank 0 every radix pass,
+  ``mpi_radix_sort.c:139,192`` — the TPU design removes the root entirely);
+* every communication step is an XLA collective over ICI
+  (``all_gather`` / ``psum`` / padded ``all_to_all``) issued from inside a
+  single ``jit``-compiled ``shard_map`` program per phase;
+* local kernels are XLA ops (``lax.sort``, scatter-add histograms), with
+  Pallas escalation hooks where XLA is the bottleneck;
+* multi-word key codecs make signed / 64-bit keys *correct* (the reference
+  sorts negatives by magnitude, ``mpi_radix_sort.c:50,56``).
+
+Layer map (mirrors SURVEY.md §7):
+
+* :mod:`mpitest_tpu.parallel` — mesh construction + the collective/"comm"
+  layer (the Python twin of the native ``comm/comm.h`` shim).
+* :mod:`mpitest_tpu.ops` — local kernels and key codecs.
+* :mod:`mpitest_tpu.models` — the two distributed sort algorithms
+  ("model families"): sample sort and radix sort.
+* :mod:`mpitest_tpu.utils` — I/O (reference text format), generators,
+  tracing/debug-log contract, metrics.
+"""
+
+from mpitest_tpu.models.api import sort, DistributedSortResult  # noqa: F401
+from mpitest_tpu.parallel.mesh import make_mesh  # noqa: F401
+
+__version__ = "0.1.0"
+
+__all__ = ["sort", "DistributedSortResult", "make_mesh", "__version__"]

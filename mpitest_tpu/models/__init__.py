@@ -1,0 +1,2 @@
+from mpitest_tpu.models.api import sort, DistributedSortResult  # noqa: F401
+from mpitest_tpu.models import radix_sort, sample_sort  # noqa: F401

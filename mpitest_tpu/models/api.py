@@ -1,0 +1,249 @@
+"""Public sort API: dispatch, sharding, overflow-retry, host assembly.
+
+This is the driver layer of the framework (the reference's ``main()`` +
+``sort()`` scaffolding, ``mpi_sample_sort.c:28-82,220-241``, redesigned):
+it owns everything that is *not* SPMD — dtype encoding, padding to static
+shapes, placing shards on the mesh, compiling the shard_map program,
+reacting to exchange overflow, and decoding results back to the host.
+
+Static-shape contract: inputs pad to ``P·n`` with max-sentinel keys
+(+∞-like, SURVEY.md §7.4 "Scatter overflow" fix — padding also makes P∤N
+inputs correct, which the reference gets wrong).  Sentinels are *real*
+maximum keys, so they sort to the global tail and slicing the first N
+elements recovers the exact multiset — bit-identical output.
+
+Overflow-retry contract: the SPMD programs return the global max per-peer
+segment length.  If it exceeded the static cap, lanes were dropped and the
+result is discarded; the host recompiles with the *exact* required cap
+(deterministic program ⇒ second run succeeds).  This replaces the
+reference's silent bucket overflow (``mpi_sample_sort.c:140-144``) and its
+"no enough sample" abort (``:96-99``) with a clean, always-correct path.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from mpitest_tpu.models import radix_sort, sample_sort
+from mpitest_tpu.ops.keys import codec_for
+from mpitest_tpu.parallel.mesh import AXIS, make_mesh
+from mpitest_tpu.utils.trace import Tracer
+
+
+@dataclass
+class DistributedSortResult:
+    """Device-resident sorted output (sharded); decode lazily on demand."""
+
+    words: tuple[jax.Array, ...]     # sharded [P*n] (radix) or [P*(P*cap)] (sample)
+    n_valid: int                     # total real keys (excludes padding)
+    dtype: np.dtype
+    counts: np.ndarray | None = None  # per-shard valid counts (ragged layouts)
+    shard_slots: int | None = None    # slots per shard for ragged layouts
+
+    def to_numpy(self) -> np.ndarray:
+        if self.n_valid == 0:
+            return np.empty(0, self.dtype)
+        codec = codec_for(self.dtype)
+        host = tuple(np.asarray(w) for w in self.words)
+        if self.counts is None:
+            return codec.decode(tuple(w[: self.n_valid] for w in host))
+        # ragged: concatenate the valid prefix of each shard's slot range,
+        # then drop the padding sentinels (global max ⇒ they sit at the tail)
+        parts = []
+        for w in host:
+            segs = [
+                w[i * self.shard_slots : i * self.shard_slots + c]
+                for i, c in enumerate(self.counts)
+            ]
+            parts.append((np.concatenate(segs) if segs else w[:0])[: self.n_valid])
+        return codec.decode(tuple(parts))
+
+    def median_probe(self) -> int:
+        """The reference's correctness probe: the (n/2)-th sorted element
+        (``int_buf[size_input / 2 - 1]``, mpi_sample_sort.c:205)."""
+        idx = self.n_valid // 2 - 1
+        if idx < 0:
+            raise ValueError("median probe undefined for < 2 keys")
+        codec = codec_for(self.dtype)
+        if self.counts is None:
+            return int(codec.decode(tuple(np.asarray(w)[idx : idx + 1] for w in self.words))[0])
+        cum = np.concatenate([[0], np.cumsum(self.counts)])
+        shard = int(np.searchsorted(cum, idx, side="right")) - 1
+        off = idx - cum[shard]
+        s = self.shard_slots
+        return int(
+            codec.decode(
+                tuple(np.asarray(w)[shard * s + off : shard * s + off + 1] for w in self.words)
+            )[0]
+        )
+
+
+def _round_cap(c: int) -> int:
+    """Round caps up to a lane-friendly multiple (TPU minor dim = 128)."""
+    return max(128, ((c + 127) // 128) * 128)
+
+
+def _needed_passes(words: tuple[np.ndarray, ...], digit_bits: int) -> int:
+    """Number of LSD passes actually required: digits above the highest
+    globally-differing bit are identical everywhere and can be skipped.
+    The principled version of the reference's ``number_digits`` pre-pass
+    (``mpi_radix_sort.c:100``).
+
+    The highest bit at which *any* two keys differ is found per word
+    (msw first) with plain max/min reductions: the first word that is not
+    constant decides — ``msb(max ^ min)`` within it, everything below it
+    needs full coverage anyway.  O(N) reductions, no copies.
+    """
+    n_words = len(words)
+    per_word = (32 + digit_bits - 1) // digit_bits
+    if words[0].size == 0:
+        return 0
+    total_bits = 0
+    for wi, w in enumerate(words):  # msw first
+        x = int(w.max()) ^ int(w.min())
+        if x:
+            total_bits = (n_words - 1 - wi) * 32 + x.bit_length()
+            break
+    return min(math.ceil(total_bits / digit_bits), per_word * n_words)
+
+
+@lru_cache(maxsize=64)
+def _compile_radix(mesh: Mesh, n_words: int, n: int, digit_bits: int, cap: int,
+                   passes: int):
+    n_ranks = mesh.devices.size
+
+    def f(*words):
+        out, max_cnt = radix_sort.radix_sort_spmd(
+            words, n_words, digit_bits, n_ranks, cap, passes
+        )
+        return out, max_cnt
+
+    return jax.jit(
+        jax.shard_map(
+            f,
+            mesh=mesh,
+            in_specs=(P(AXIS),) * n_words,
+            out_specs=((P(AXIS),) * n_words, P()),
+        )
+    )
+
+
+@lru_cache(maxsize=64)
+def _compile_sample(mesh: Mesh, n_words: int, n: int, cap: int, oversample: int):
+    n_ranks = mesh.devices.size
+
+    def f(*words):
+        out, count, max_cnt = sample_sort.sample_sort_spmd(
+            words, n_words, n_ranks, cap, oversample
+        )
+        return out, count[None], max_cnt
+
+    return jax.jit(
+        jax.shard_map(
+            f,
+            mesh=mesh,
+            in_specs=(P(AXIS),) * n_words,
+            out_specs=((P(AXIS),) * n_words, P(AXIS), P()),
+        )
+    )
+
+
+def _shard_input(words_np, mesh, n, pad_words):
+    P_ = mesh.devices.size
+    sharding = NamedSharding(mesh, P(AXIS))
+    out = []
+    for w, pad_val in zip(words_np, pad_words):
+        if w.size < P_ * n:
+            w = np.concatenate([w, np.full(P_ * n - w.size, pad_val, np.uint32)])
+        out.append(jax.device_put(w, sharding))
+    return tuple(out)
+
+
+def sort(
+    x,
+    algorithm: str = "radix",
+    mesh: Mesh | None = None,
+    digit_bits: int = 8,
+    cap_factor: float = 2.0,
+    oversample: int | None = None,
+    tracer: Tracer | None = None,
+    return_result: bool = False,
+):
+    """Sort integer keys on the mesh; returns a sorted numpy array
+    (or the device-resident :class:`DistributedSortResult`).
+
+    ``algorithm``: ``"radix"`` (flagship: perfectly load-balanced, fixed
+    pass count) or ``"sample"`` (one exchange round; cap-sensitive under
+    skew).  Both produce identical bytes — sorted output is canonical.
+    """
+    tracer = tracer or Tracer()
+    x = np.asarray(x)
+    dtype = x.dtype
+    codec = codec_for(dtype)
+    N = x.size
+    if N == 0:
+        return x.copy() if not return_result else DistributedSortResult((), 0, dtype)
+    if mesh is None:
+        mesh = make_mesh()
+    n_ranks = int(mesh.devices.size)
+    n = max(1, math.ceil(N / n_ranks))
+
+    with tracer.phase("encode"):
+        words_np = codec.encode(x.reshape(-1))
+    sentinel = codec.max_sentinel()
+
+    with tracer.phase("device_put"):
+        words = _shard_input(words_np, mesh, n, sentinel)
+
+    if algorithm == "radix":
+        with tracer.phase("plan"):
+            # Padding sentinels participate in the sort, so plan over them too.
+            plan_words = words_np if N == n_ranks * n else tuple(
+                np.concatenate([w, np.asarray([s], np.uint32)])
+                for w, s in zip(words_np, sentinel)
+            )
+            passes = _needed_passes(plan_words, digit_bits)
+        cap = _round_cap(int(n / n_ranks * cap_factor) + 1)
+        while True:
+            fn = _compile_radix(mesh, codec.n_words, n, digit_bits, cap, passes)
+            with tracer.phase("sort"):
+                out, max_cnt = fn(*words)
+                max_cnt = int(max_cnt)
+            if max_cnt <= cap:
+                break
+            tracer.verbose(f"radix exchange overflow (need {max_cnt} > cap {cap}); retrying")
+            cap = _round_cap(max_cnt)
+        res = DistributedSortResult(out, N, dtype)
+    elif algorithm == "sample":
+        if oversample is None:
+            oversample = max(2 * n_ranks - 1, 8)
+        oversample = min(oversample, n)
+        cap = _round_cap(int(n / n_ranks * cap_factor) + 1)
+        while True:
+            fn = _compile_sample(mesh, codec.n_words, n, cap, oversample)
+            with tracer.phase("sort"):
+                out, counts, max_cnt = fn(*words)
+                max_cnt = int(max_cnt)
+            if max_cnt <= cap:
+                break
+            tracer.verbose(f"sample exchange overflow (need {max_cnt} > cap {cap}); retrying")
+            cap = _round_cap(max_cnt)
+        counts = np.asarray(counts)
+        res = DistributedSortResult(
+            out, N, dtype, counts=counts, shard_slots=n_ranks * cap
+        )
+    else:
+        raise ValueError(f"unknown algorithm {algorithm!r}")
+
+    if return_result:
+        return res
+    with tracer.phase("decode"):
+        out_np = res.to_numpy()
+    return out_np

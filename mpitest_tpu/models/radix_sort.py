@@ -1,0 +1,129 @@
+"""Distributed LSD radix sort — device-resident, root-free, load-balanced.
+
+The reference (``mpi_radix_sort.c:60-205``) runs, per digit: root scatters
+the whole array, every rank buckets by digit value, buckets travel to the
+rank *owning that digit* (rank = digit, radix = P), then everything gathers
+back to root — O(N) bytes through rank 0 every pass, and digit ownership
+means skewed data piles onto one rank.
+
+The TPU design is different in three load-bearing ways:
+
+1. **Keys never leave the mesh.**  The array stays sharded [P, n] across
+   all passes; only 256-entry histograms are globally replicated
+   (``all_gather``).  This removes the root bandwidth bottleneck
+   (SURVEY.md §5 "long-context" row).
+
+2. **Destination = global sorted position, not digit owner.**  Each pass
+   computes, for every key, its exact global index in the digit-stable
+   order:
+
+       dest(key i, digit d) = digit_base[d] + rank_base[r, d] + occ_i
+
+   where ``digit_base`` is the exclusive scan of global digit totals,
+   ``rank_base`` the exclusive scan over ranks (the MPI_Exscan analogue),
+   and ``occ_i`` the key's stable occurrence number locally.  Keys then
+   move to ``dest // n`` — so every device ends every pass with *exactly*
+   ``n`` keys, regardless of skew.  (The reference's per-pass root
+   round-trip is what re-balances its shards; here balance is intrinsic.)
+
+3. **8-bit digits, integer math.**  Digit width decouples from mesh size
+   (the reference couples radix to P, ``mpi_radix_sort.c:64``) and digits
+   are shift/mask, not ``pow()`` (``mpi_radix_sort.c:54-58``).
+
+Monotonicity property used by the exchange: after the local stable sort by
+digit, ``dest`` is strictly increasing, so each destination device's keys
+form one contiguous segment — exactly what
+:func:`~mpitest_tpu.parallel.collectives.ragged_all_to_all` ships.
+
+Stability across ranks matches the reference's in-rank-order Recv loop
+(``mpi_radix_sort.c:168-173``); the scatter at the receiver is
+deterministic (every key lands at its computed offset), so output is
+bit-identical run to run — arrival order never matters.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mpitest_tpu.ops import kernels
+from mpitest_tpu.parallel import collectives as coll
+from mpitest_tpu.parallel.mesh import AXIS
+
+Words = tuple[jax.Array, ...]
+
+
+def _one_pass(words: Words, word_idx: int, shift: int, digit_bits: int,
+              n_ranks: int, cap: int, axis: str) -> tuple[Words, jax.Array]:
+    n = words[0].shape[0]
+    n_bins = 1 << digit_bits
+    my = lax.axis_index(axis)
+
+    d = kernels.digit_at(words[word_idx], shift, digit_bits)
+    h = kernels.histogram(d, n_bins)
+    _, tot, rank_base = coll.exscan_counts(h, axis)
+    digit_base = coll.exclusive_cumsum(tot)
+    base = digit_base + rank_base[my]
+
+    perm, sd = kernels.stable_rank_by_digit(d)
+    sorted_words = tuple(w[perm] for w in words)
+    local_start = coll.exclusive_cumsum(h)
+    j = lax.iota(jnp.int32, n)
+    dest = base[sd] + (j - local_start[sd])
+
+    bounds = lax.iota(jnp.int32, n_ranks) * n
+    send_start = jnp.searchsorted(dest, bounds, side="left").astype(jnp.int32)
+    seg_end = jnp.concatenate([send_start[1:], jnp.asarray([n], jnp.int32)])
+    send_cnt = seg_end - send_start
+
+    payload = tuple(list(sorted_words) + [dest])
+    recv, recv_cnt, max_cnt = coll.ragged_all_to_all(
+        payload, send_start, send_cnt, cap, n_ranks, axis
+    )
+    rwords, rdest = recv[:-1], recv[-1]
+
+    c = lax.iota(jnp.int32, cap)
+    valid = c[None, :] < recv_cnt[:, None]                           # [P, cap]
+    local_off = jnp.where(valid, rdest - my * n, n).reshape(-1)      # n = drop slot
+    out_words = tuple(
+        jnp.zeros((n,), w.dtype).at[local_off].set(w.reshape(-1), mode="drop")
+        for w in rwords
+    )
+    return out_words, max_cnt
+
+
+def radix_sort_spmd(
+    words: Words,
+    n_words: int,
+    digit_bits: int,
+    n_ranks: int,
+    cap: int,
+    passes: int | None = None,
+    axis: str = AXIS,
+) -> tuple[Words, jax.Array]:
+    """Full multi-pass radix sort of the shard. SPMD; call under shard_map.
+
+    ``passes`` limits the number of digit passes (host may have computed
+    that high words are all-equal — the reference's ``number_digits``
+    optimization, ``mpi_radix_sort.c:100``, done right).  Passes run from
+    the least-significant digit of the least-significant word upward.
+
+    Returns ``(sorted_words, max_send_cnt_over_passes)`` — the second value
+    > cap means an exchange overflowed and the host must retry with that
+    cap (deterministic, so the retry is exact).
+    """
+    per_word = (32 + digit_bits - 1) // digit_bits
+    total = per_word * n_words if passes is None else passes
+    max_cnt = jnp.zeros((), jnp.int32)
+    done = 0
+    for w_idx in range(n_words - 1, -1, -1):          # lsw first
+        for p in range(per_word):
+            if done >= total:
+                break
+            words, mc = _one_pass(
+                words, w_idx, p * digit_bits, digit_bits, n_ranks, cap, axis
+            )
+            max_cnt = jnp.maximum(max_cnt, mc)
+            done += 1
+    return words, max_cnt

@@ -1,0 +1,97 @@
+"""Distributed sample sort — splitter-based repartitioning, TPU-native.
+
+Reference algorithm (``mpi_sample_sort.c:28-218``): local sort → each rank
+sends 2P-1 evenly spaced samples to rank 0 → rank 0 sorts P·(2P-1) samples,
+picks P-1 splitters, broadcasts → per-key linear bucket scan → hand-rolled
+Alltoallv (tag = length) → local sort → Gatherv to root.
+
+TPU redesign:
+
+* **Splitters are computed replicated**, not on a root: samples ride one
+  ``all_gather`` (tiny: P·s words) and every device sorts them and picks
+  identical splitters — the Isend-per-sample / tag-as-index protocol
+  (``mpi_sample_sort.c:101,112``) has no reason to exist on a mesh.
+* **Bucketing is one vectorized lexicographic searchsorted**
+  (:func:`mpitest_tpu.ops.kernels.searchsorted_words`), not an O(P)-per-key
+  scan (``mpi_sample_sort.c:148-155``).  Keys are already locally sorted,
+  so bucket ids are monotone ⇒ per-destination segments are contiguous ⇒
+  the shared ragged exchange applies.
+* **The bucket cap is honest.**  The reference fixes capacity at
+  1.5·(N/P)·2 and silently overflows under skew
+  (``mpi_sample_sort.c:140-144``).  Here the cap is static for XLA but
+  overflow is *detected* (returned max_send_cnt) and the host retries with
+  the exact cap — the Zipf stress config's failure mode becomes a
+  recompile, not a corruption.
+
+Output stays sharded and ragged: each device holds ``P·cap`` slots of
+which the first ``count`` (after the final local sort, with max-sentinel
+fill) are valid.  Gather-to-root happens only at the host boundary for
+verification/output, mirroring what SURVEY.md §2.3 prescribes for
+``MPI_Gatherv``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mpitest_tpu.ops import kernels
+from mpitest_tpu.parallel import collectives as coll
+from mpitest_tpu.parallel.mesh import AXIS
+
+Words = tuple[jax.Array, ...]
+
+
+def select_splitters(sorted_words: Words, n_ranks: int, oversample: int,
+                     axis: str = AXIS) -> Words:
+    """Evenly spaced local samples → all_gather → replicated splitters.
+
+    ``oversample`` is the per-rank sample count (the reference uses 2P-1,
+    ``mpi_sample_sort.c:89``); larger values tighten splitter balance at
+    negligible cost (P·oversample words total)."""
+    samples = kernels.evenly_spaced_samples(sorted_words, oversample)
+    gathered = tuple(coll.all_gather(s, axis).reshape(-1) for s in samples)  # [P*s]
+    gsorted = kernels.local_sort(gathered)
+    m = n_ranks * oversample
+    idx = (jnp.arange(1, n_ranks, dtype=jnp.int32) * m) // n_ranks           # P-1 picks
+    return tuple(w[idx] for w in gsorted)
+
+
+def sample_sort_spmd(
+    words: Words,
+    n_words: int,
+    n_ranks: int,
+    cap: int,
+    oversample: int,
+    axis: str = AXIS,
+) -> tuple[Words, jax.Array, jax.Array]:
+    """Full sample sort of the shard. SPMD; call under shard_map.
+
+    Returns ``(out_words, count, max_send_cnt)`` where ``out_words`` are
+    [P*cap] per-device buffers whose first ``count`` slots are the valid
+    globally-sorted run for this shard position.
+    """
+    sorted_words = kernels.local_sort(words)
+    splitters = select_splitters(sorted_words, n_ranks, oversample, axis)
+
+    # dest[i] = number of splitters < key[i]  ∈ [0, P-1]; monotone since sorted.
+    dest = kernels.searchsorted_words(splitters, sorted_words)
+
+    n = words[0].shape[0]
+    h = kernels.histogram(dest, n_ranks)
+    send_start = coll.exclusive_cumsum(h)
+    send_cnt = h
+
+    sentinel = (0xFFFFFFFF,) * n_words
+    recv, recv_cnt, max_cnt = coll.ragged_all_to_all(
+        sorted_words, send_start, send_cnt, cap, n_ranks, axis,
+        fill=sentinel,
+    )
+    # Invalid lanes are max-sentinel filled → they sort to the tail; the
+    # first `count` slots after sorting are exactly the valid multiset
+    # (canonical-output argument, SURVEY.md §7.3).
+    flat = tuple(r.reshape(-1) for r in recv)
+    out = kernels.local_sort(flat)
+    count = jnp.minimum(recv_cnt, cap).sum().astype(jnp.int32)
+    return out, count, max_cnt

@@ -1,0 +1,2 @@
+from mpitest_tpu.ops.keys import KeyCodec, codec_for  # noqa: F401
+from mpitest_tpu.ops import kernels  # noqa: F401

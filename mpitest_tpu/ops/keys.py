@@ -1,0 +1,91 @@
+"""Key codecs: map host integer dtypes to tuples of sortable uint32 words.
+
+TPU-first design decision: JAX on TPU runs with 32-bit integers by default
+(no x64), and the MXU/VPU paths are widest for 32-bit lanes.  Rather than
+flipping global x64 flags, every key dtype is encoded as a tuple of
+**uint32 words, most-significant first**, such that lexicographic unsigned
+comparison of the word tuple equals the native comparison of the original
+keys.  ``lax.sort`` with ``num_keys=len(words)`` then sorts any supported
+dtype, and LSD radix passes simply iterate words from least- to
+most-significant.
+
+This fixes a reference bug: ``mpi_radix_sort.c:50,56`` takes ``abs(value)``,
+so negative keys sort by magnitude with the sign dropped.  The biased
+encoding here (sign-bit flip) makes signed sorts actually correct; the
+divergence is documented in SURVEY.md §7.4.
+
+Sentinel values: ``max_sentinel`` is the all-ones word tuple, which encodes
+to the maximum representable key and therefore sorts after every real key.
+Padding slots use it so static-shape sorts keep valid data as a prefix.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+_SIGN32 = np.uint32(0x80000000)
+
+
+@dataclass(frozen=True)
+class KeyCodec:
+    """Encode/decode a host integer dtype to/from uint32 word tuples."""
+
+    dtype: np.dtype
+    n_words: int
+
+    def encode(self, x: np.ndarray) -> tuple[np.ndarray, ...]:
+        """Host array -> tuple of uint32 word arrays, most-significant first."""
+        x = np.asarray(x, dtype=self.dtype)
+        if self.dtype == np.dtype(np.int32):
+            return ((x.view(np.uint32) ^ _SIGN32),)
+        if self.dtype == np.dtype(np.uint32):
+            return (x.copy(),)
+        if self.dtype == np.dtype(np.int64):
+            u = x.view(np.uint64) ^ np.uint64(0x8000000000000000)
+            return (
+                (u >> np.uint64(32)).astype(np.uint32),
+                (u & np.uint64(0xFFFFFFFF)).astype(np.uint32),
+            )
+        if self.dtype == np.dtype(np.uint64):
+            return (
+                (x >> np.uint64(32)).astype(np.uint32),
+                (x & np.uint64(0xFFFFFFFF)).astype(np.uint32),
+            )
+        raise TypeError(f"unsupported key dtype: {self.dtype}")
+
+    def decode(self, words: tuple[np.ndarray, ...]) -> np.ndarray:
+        """Tuple of uint32 word arrays (msw first) -> host array of dtype."""
+        words = tuple(np.asarray(w, dtype=np.uint32) for w in words)
+        if len(words) != self.n_words:
+            raise ValueError(f"expected {self.n_words} words, got {len(words)}")
+        if self.dtype == np.dtype(np.int32):
+            return (words[0] ^ _SIGN32).view(np.int32)
+        if self.dtype == np.dtype(np.uint32):
+            return words[0].copy()
+        u = (words[0].astype(np.uint64) << np.uint64(32)) | words[1].astype(np.uint64)
+        if self.dtype == np.dtype(np.int64):
+            return (u ^ np.uint64(0x8000000000000000)).view(np.int64)
+        return u  # uint64
+
+    def max_sentinel(self) -> tuple[int, ...]:
+        """Word values that encode the maximum key (sorts last)."""
+        return (0xFFFFFFFF,) * self.n_words
+
+
+_CODECS = {
+    np.dtype(np.int32): KeyCodec(np.dtype(np.int32), 1),
+    np.dtype(np.uint32): KeyCodec(np.dtype(np.uint32), 1),
+    np.dtype(np.int64): KeyCodec(np.dtype(np.int64), 2),
+    np.dtype(np.uint64): KeyCodec(np.dtype(np.uint64), 2),
+}
+
+
+def codec_for(dtype) -> KeyCodec:
+    dt = np.dtype(dtype)
+    if dt not in _CODECS:
+        raise TypeError(
+            f"unsupported key dtype {dt}; supported: {sorted(str(k) for k in _CODECS)}"
+        )
+    return _CODECS[dt]

@@ -1,0 +1,2 @@
+from mpitest_tpu.parallel.mesh import make_mesh, multihost_init  # noqa: F401
+from mpitest_tpu.parallel import collectives  # noqa: F401

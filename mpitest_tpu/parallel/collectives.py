@@ -1,0 +1,119 @@
+"""The comm layer: XLA collectives shaped like the reference's MPI surface.
+
+This is the Python twin of the native ``comm/comm.h`` shim (SURVEY.md §2.3
+maps every ``MPI_*`` call to its TPU-native equivalent).  Everything here is
+meant to be called *inside* a ``shard_map``-ed function over the 1-D mesh
+axis; all shapes are static, so the whole SPMD program compiles to one XLA
+executable with collectives scheduled on ICI.
+
+The centerpiece is :func:`ragged_all_to_all` — the replacement for the
+reference's hand-rolled ``MPI_Alltoallv`` (payload length smuggled in the
+message tag, ``mpi_sample_sort.c:159-171``; per-peer Isend/Recv loops,
+``mpi_radix_sort.c:150-173``).  XLA's ``all_to_all`` is fixed-shape, so
+variable buckets ride a static per-peer cap with explicit counts — which
+*legitimizes* the reference's own fixed ``max_size_bucket``-plus-length-
+in-tag scheme, minus the tag hack and minus the silent overflow
+(``mpi_sample_sort.c:140-144``): overflow is detected and reported so the
+host can retry with the exact required cap (see models/api.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mpitest_tpu.parallel.mesh import AXIS
+
+Words = tuple[jax.Array, ...]
+
+
+def rank(axis: str = AXIS) -> jax.Array:
+    """``MPI_Comm_rank`` → ``lax.axis_index`` (traced scalar)."""
+    return lax.axis_index(axis)
+
+
+def all_gather(x: jax.Array, axis: str = AXIS) -> jax.Array:
+    """``MPI_Allgather`` (and the gather-to-root patterns): every shard gets
+    [P, ...] — strictly more than MPI's rooted Gather gives, for free."""
+    return lax.all_gather(x, axis)
+
+
+def psum(x: jax.Array, axis: str = AXIS) -> jax.Array:
+    """``MPI_Allreduce(SUM)``."""
+    return lax.psum(x, axis)
+
+
+def pmax(x: jax.Array, axis: str = AXIS) -> jax.Array:
+    return lax.pmax(x, axis)
+
+
+def exclusive_cumsum(x: jax.Array, axis: int = 0) -> jax.Array:
+    """Exclusive prefix sum — the root-side displacement computation
+    (``mpi_sample_sort.c:188-192``) done replicated on-device."""
+    c = jnp.cumsum(x, axis=axis)
+    return c - x  # exclusive
+
+
+def exscan_counts(h: jax.Array, axis: str = AXIS) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Global exclusive scan of per-rank count vectors.
+
+    ``h``: int32[B] local histogram.  Returns ``(H, tot, rank_base)`` where
+    ``H`` is int32[P, B] (all ranks' histograms, replicated via all_gather),
+    ``tot[b] = sum_r H[r, b]``, and ``rank_base[r, b] = sum_{r'<r} H[r', b]``
+    — the ``MPI_Exscan`` equivalent, computed replicated because P×B is tiny
+    next to the key payload.
+    """
+    H = all_gather(h, axis)            # [P, B]
+    tot = H.sum(axis=0)                # [B]
+    rank_base = exclusive_cumsum(H, 0)  # [P, B]
+    return H, tot, rank_base
+
+
+def ragged_all_to_all(
+    arrays: Words,
+    send_start: jax.Array,  # int32[P] — start offset of the segment for peer p
+    send_cnt: jax.Array,    # int32[P] — number of valid elements for peer p
+    cap: int,               # static per-peer capacity
+    n_ranks: int,           # static mesh axis size
+    axis: str = AXIS,
+    fill: tuple[int, ...] | None = None,  # per-array fill word for invalid lanes
+) -> tuple[Words, jax.Array, jax.Array]:
+    """``MPI_Alltoallv`` for contiguous ragged segments, on static shapes.
+
+    Each local array is logically partitioned into P contiguous segments
+    (``send_start[p] .. send_start[p]+send_cnt[p]``); segment p is delivered
+    to rank p.  Both sort algorithms produce *contiguous* per-destination
+    segments by construction (keys are in destination-monotone order before
+    the exchange), so a gather of ``cap`` lanes per peer builds the send
+    matrix without any serial packing loop.
+
+    Returns ``(recv_arrays, recv_cnt, max_send_cnt)``:
+      * ``recv_arrays[k]``: [P, cap] — lane (s, c) holds element c of the
+        segment rank s sent to me (valid iff ``c < recv_cnt[s]``);
+      * ``recv_cnt``: int32[P] — the explicit count exchange that replaces
+        the reference's tag-as-length trick;
+      * ``max_send_cnt``: int32 scalar, globally reduced — ``> cap`` means
+        the exchange overflowed and lanes were dropped; the caller retries
+        with ``cap = max_send_cnt`` (exact, since the program is
+        deterministic).
+    """
+    n = arrays[0].shape[0]
+    c = lax.iota(jnp.int32, cap)                      # [cap]
+    idx = send_start[:, None] + c[None, :]            # [P, cap]
+    valid = c[None, :] < send_cnt[:, None]            # [P, cap]
+    gidx = jnp.clip(idx, 0, n - 1)
+
+    # Explicit count exchange (replaces tag-as-length, mpi_sample_sort.c:161,168).
+    recv_cnt = lax.all_to_all(jnp.minimum(send_cnt, cap), axis, 0, 0, tiled=True)
+
+    recv_arrays = []
+    for k, a in enumerate(arrays):
+        send = a[gidx]                                 # [P, cap]
+        if fill is not None:
+            send = jnp.where(valid, send, jnp.asarray(fill[k], a.dtype))
+        recv = lax.all_to_all(send, axis, 0, 0, tiled=True)
+        recv_arrays.append(recv)
+
+    max_send_cnt = lax.pmax(send_cnt.max(), axis)
+    return tuple(recv_arrays), recv_cnt, max_send_cnt

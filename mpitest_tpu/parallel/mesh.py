@@ -1,0 +1,58 @@
+"""Device mesh construction — the TPU replacement for ``mpirun -np P``.
+
+The reference acquires its process group from ``MPI_Init`` +
+``MPI_Comm_size/rank`` (``mpi_sample_sort.c:225-227``).  Here the "process
+group" is a 1-D ``jax.sharding.Mesh`` over ICI; rank/size become
+``lax.axis_index`` / the static axis size inside ``shard_map``.  The 1-D
+logical mesh is kept topology-agnostic so the same algorithm code compiles
+over a multi-host ICI+DCN hybrid mesh (v5e-16 config, SURVEY.md §7.3) —
+only this module knows about hosts.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS = "x"  # the single key axis; all sharding is 1-D over it
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """Build the 1-D mesh over all (or the first ``n_devices``) devices."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(f"requested {n_devices} devices, have {len(devices)}")
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (AXIS,))
+
+
+def key_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for a [N]-shaped key-word array: block-split on the key axis
+    (the TPU equivalent of the reference's MPI_Scatter block distribution,
+    ``mpi_sample_sort.c:72-82`` — minus its P∤N overflow bug)."""
+    return NamedSharding(mesh, P(AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def multihost_init(coordinator: str | None = None, num_processes: int | None = None,
+                   process_id: int | None = None) -> None:
+    """Multi-host runtime bring-up (v5e-16-and-beyond path).
+
+    Thin wrapper over ``jax.distributed.initialize`` — the TPU-native
+    equivalent of ``MPI_Init`` across nodes; collectives then ride
+    ICI within a slice and DCN across slices with no algorithm changes.
+    No-op when running single-process (the common case in tests/bench).
+    """
+    if coordinator is None and num_processes is None:
+        return  # single-process: nothing to do
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )

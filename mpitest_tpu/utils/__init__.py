@@ -1,0 +1,1 @@
+from mpitest_tpu.utils import io, trace  # noqa: F401

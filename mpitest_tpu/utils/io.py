@@ -1,0 +1,70 @@
+"""Input/output: the reference text format, a binary fast path, generators.
+
+The reference reads whitespace-separated decimal ints on rank 0 with a
+one-int-at-a-time ``realloc`` loop (``mpi_sample_sort.c:41-60``,
+``mpi_radix_sort.c:74-97``).  That loop has a known ``feof`` overcount bug
+(SURVEY.md §2.2) — this reader reads *exactly* the tokens present.
+
+The reference ships no generators; the benchmark configs (BASELINE.json)
+need uniform and Zipf(1.1) key streams, so they live here.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def read_keys_text(path: str, dtype=np.int32) -> np.ndarray:
+    """Read whitespace-separated decimal integers (reference input format)."""
+    dt = np.dtype(dtype)
+    if dt == np.dtype(np.uint64):
+        # int64 intermediate would saturate keys above 2^63-1; parse exactly.
+        with open(path) as f:
+            return np.array([int(t) for t in f.read().split()], dtype=dt)
+    try:
+        arr = np.fromfile(path, dtype=np.int64, sep=" ")
+    except FileNotFoundError:
+        raise FileNotFoundError(f"'{path}' is not a valid file for read.")
+    return arr.astype(dt)
+
+
+def write_keys_text(path: str, keys: np.ndarray) -> None:
+    """Write keys in the reference input format (one int per line)."""
+    np.savetxt(path, np.asarray(keys).reshape(-1), fmt="%d")
+
+
+def read_keys_binary(path: str, dtype=np.int32) -> np.ndarray:
+    """Binary fast path: raw little-endian keys (for 2^30-scale benches,
+    where text parsing would dominate the measured span's setup)."""
+    return np.fromfile(path, dtype=dtype)
+
+
+def write_keys_binary(path: str, keys: np.ndarray) -> None:
+    np.asarray(keys).tofile(path)
+
+
+def generate_uniform(n: int, dtype=np.int32, seed: int = 0) -> np.ndarray:
+    """Uniform random keys over the full range of ``dtype``."""
+    rng = np.random.default_rng(seed)
+    dt = np.dtype(dtype)
+    info = np.iinfo(dt)
+    return rng.integers(info.min, info.max, size=n, dtype=dt, endpoint=True)
+
+
+def generate_zipf(n: int, a: float = 1.1, dtype=np.int64, seed: int = 0) -> np.ndarray:
+    """Zipf-skewed keys — the splitter-imbalance stressor (BASELINE.json
+    configs[4]).  Heavy duplication of small values exercises bucket-cap
+    overflow paths (the reference overflows silently,
+    ``mpi_sample_sort.c:140-144``; this framework detects and retries)."""
+    rng = np.random.default_rng(seed)
+    info = np.iinfo(np.dtype(dtype))
+    vals = rng.zipf(a, size=n)
+    return np.clip(vals, None, int(info.max)).astype(dtype)
+
+
+def generate(kind: str, n: int, dtype=np.int32, seed: int = 0) -> np.ndarray:
+    if kind == "uniform":
+        return generate_uniform(n, dtype, seed)
+    if kind == "zipf":
+        return generate_zipf(n, dtype=dtype, seed=seed)
+    raise ValueError(f"unknown generator kind: {kind!r}")

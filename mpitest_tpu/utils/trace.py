@@ -1,0 +1,69 @@
+"""Debug-log + phase-timing contract.
+
+The reference's observability surface is (a) an integer debug level from
+``argv[2]`` gating printf traces with ``[MASTER] [SLAVE] [COMMON] [VERBOSE]
+[ERROR]`` prefixes (``mpi_sample_sort.c:30,42,62,117``), and (b) one
+``MPI_Wtime`` pair on rank 0 printed to stderr
+(``mpi_sample_sort.c:61,201,207``).  This module keeps that CLI contract
+(same prefixes, same levels) and adds what the reference lacks: per-phase
+wall timers and a structured metrics sidecar hook (see
+:mod:`mpitest_tpu.utils.metrics`).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Tracer:
+    """Reference-compatible leveled logger + phase timer."""
+
+    level: int = 0
+    phases: dict[str, float] = field(default_factory=dict)
+
+    # -- reference printf contract ------------------------------------
+    def common(self, msg: str, min_level: int = 1) -> None:
+        if self.level >= min_level:
+            print(f"[COMMON] {msg}")
+
+    def verbose(self, msg: str) -> None:
+        if self.level >= 1:
+            print(f"[VERBOSE] {msg}")
+
+    def master(self, msg: str, min_level: int = 2) -> None:
+        if self.level >= min_level:
+            print(f"[MASTER] {msg}")
+
+    def error(self, msg: str) -> None:
+        print(f"[ERROR] {msg}", file=sys.stderr)
+
+    # -- additions: per-phase timers ----------------------------------
+    @contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.phases[name] = self.phases.get(name, 0.0) + dt
+            if self.level >= 1:
+                print(f"[VERBOSE] phase {name}: {dt*1e3:.3f} ms")
+
+
+@contextmanager
+def jax_profile(logdir: str | None):
+    """Optional jax.profiler trace around the hot region (TPU tracing hook)."""
+    if not logdir:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,43 @@
+"""Test harness: simulate the device mesh on CPU, no TPU required.
+
+Multi-"node" simulation without a cluster (SURVEY.md §4): the reference was
+exercised via ``mpirun -np P`` on one host; the TPU-native equivalent is a
+virtual P-device CPU mesh via ``--xla_force_host_platform_device_count``,
+so all ``shard_map``/collective code runs unmodified.
+
+The env/config overrides MUST happen before the first JAX backend query
+(this image's sitecustomize pins an experimental TPU platform).
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from mpitest_tpu.parallel.mesh import make_mesh
+
+    assert len(jax.devices()) == 8, "virtual CPU mesh not active"
+    return make_mesh(8)
+
+
+@pytest.fixture(scope="session")
+def mesh4():
+    from mpitest_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(4)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(1234)

@@ -1,0 +1,51 @@
+import numpy as np
+import pytest
+
+from mpitest_tpu.utils import io
+
+
+def test_text_roundtrip(tmp_path):
+    x = np.array([5, -3, 2147483647, -2147483648, 0], np.int32)
+    p = str(tmp_path / "keys.txt")
+    io.write_keys_text(p, x)
+    np.testing.assert_array_equal(io.read_keys_text(p), x)
+
+
+def test_reads_exact_count(tmp_path):
+    """No feof overcount (reference bug, mpi_sample_sort.c:50)."""
+    p = str(tmp_path / "keys.txt")
+    with open(p, "w") as f:
+        f.write("1 2 3\n")  # trailing newline: reference would count 4
+    got = io.read_keys_text(p)
+    assert got.shape == (3,)
+
+
+def test_binary_roundtrip(tmp_path):
+    x = np.arange(-50, 50, dtype=np.int32)
+    p = str(tmp_path / "keys.bin")
+    io.write_keys_binary(p, x)
+    np.testing.assert_array_equal(io.read_keys_binary(p), x)
+
+
+def test_generators():
+    u = io.generate_uniform(1000, np.int32, seed=7)
+    assert u.dtype == np.int32 and u.shape == (1000,)
+    assert io.generate_uniform(1000, np.int32, seed=7).tolist() == u.tolist()
+    z = io.generate_zipf(1000, dtype=np.int64, seed=7)
+    assert z.dtype == np.int64 and (z >= 1).all()
+    # zipf must actually be skewed: top value should dominate
+    vals, counts = np.unique(z, return_counts=True)
+    assert counts.max() > 50
+
+
+def test_uint64_text_exact(tmp_path):
+    """Keys above 2^63-1 must not saturate through an int64 intermediate."""
+    p = str(tmp_path / "u64.txt")
+    x = np.array([2**64 - 1, 0, 2**63], np.uint64)
+    io.write_keys_text(p, x)
+    np.testing.assert_array_equal(io.read_keys_text(p, np.uint64), x)
+
+
+def test_missing_file():
+    with pytest.raises(FileNotFoundError):
+        io.read_keys_text("/nonexistent/file.txt")

@@ -1,0 +1,107 @@
+"""Benchmark driver: one JSON metric line on stdout, details on stderr.
+
+Primary metric (BASELINE.md row 4): radix-sort throughput in Mkeys/s on
+the flagship device-resident path.  ``vs_baseline`` is the ratio against
+the host-CPU baseline sorting the same keys (``np.sort``, a stand-in for
+the reference's host-CPU MPI ranks, which need an mpirun this image lacks;
+the native pthreads backend is measured separately in bench/).
+
+The timed span mirrors the reference's timer (``mpi_sample_sort.c:61,201``:
+after file read → after result materialization): host→device distribution +
+full multi-pass SPMD sort + ``block_until_ready``.  Host-side decode is
+excluded — on TPU the result *stays* sharded on the mesh by design
+(SURVEY.md §2.3 Gatherv row); correctness is probed separately.
+
+Env knobs: BENCH_LOG2N (default 26 on TPU, 20 on CPU), BENCH_ALGO
+(radix|sample), BENCH_REPEATS (default 3), BENCH_DTYPE (int32).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    import jax
+
+    from mpitest_tpu.models.api import sort
+    from mpitest_tpu.parallel.mesh import make_mesh
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform not in ("cpu",)
+    log2n = int(os.environ.get("BENCH_LOG2N", "26" if on_tpu else "20"))
+    algo = os.environ.get("BENCH_ALGO", "radix")
+    repeats = int(os.environ.get("BENCH_REPEATS", "3"))
+    dtype = np.dtype(os.environ.get("BENCH_DTYPE", "int32"))
+    n = 1 << log2n
+
+    log(f"bench: platform={platform} devices={len(jax.devices())} "
+        f"algo={algo} N=2^{log2n} dtype={dtype} repeats={repeats}")
+
+    rng = np.random.default_rng(0)
+    info = np.iinfo(dtype)
+    x = rng.integers(info.min, info.max, size=n, dtype=dtype, endpoint=True)
+    mesh = make_mesh()
+
+    # Host-CPU baseline: same keys, single-node sort.
+    t0 = time.perf_counter()
+    ref = np.sort(x)
+    base_s = time.perf_counter() - t0
+    base_mkeys = n / base_s / 1e6
+    log(f"baseline np.sort: {base_s:.3f}s = {base_mkeys:.1f} Mkeys/s")
+
+    # Warmup: compiles the program and settles the exchange cap.
+    res = sort(x, algorithm=algo, mesh=mesh, return_result=True)
+    probe = res.median_probe()
+    expect = int(ref[n // 2 - 1])
+    ok = probe == expect
+    log(f"median probe: got {probe} expect {expect} ({'OK' if ok else 'MISMATCH'})")
+    if not ok:
+        log("CORRECTNESS FAILURE — reporting value 0")
+        print(json.dumps({"metric": f"{algo}_sort_mkeys_per_s", "value": 0.0,
+                          "unit": "Mkeys/s", "vs_baseline": 0.0}))
+        return
+
+    from mpitest_tpu.utils.metrics import Metrics
+    from mpitest_tpu.utils.trace import Tracer
+
+    metrics = Metrics(config={"platform": platform, "algo": algo,
+                              "log2n": log2n, "dtype": dtype.name,
+                              "devices": len(jax.devices())})
+    tracer = Tracer()
+    times = []
+    for i in range(repeats):
+        t0 = time.perf_counter()
+        r = sort(x, algorithm=algo, mesh=mesh, return_result=True, tracer=tracer)
+        for w in r.words:
+            w.block_until_ready()
+        dt = time.perf_counter() - t0
+        times.append(dt)
+        log(f"run {i}: {dt:.3f}s = {n/dt/1e6:.1f} Mkeys/s")
+
+    best = min(times)
+    mkeys = metrics.throughput("sort_mkeys_per_s", n, best)
+    metrics.record("baseline_np_sort_mkeys_per_s", round(base_mkeys, 3), "Mkeys/s")
+    metrics.record_phases(tracer.phases)
+    metrics.dump()  # structured sidecar → stderr
+
+    # The driver contract: exactly one JSON line on stdout.
+    print(json.dumps({
+        "metric": f"{algo}_sort_mkeys_per_s_2e{log2n}_{dtype.name}",
+        "value": round(mkeys, 2),
+        "unit": "Mkeys/s",
+        "vs_baseline": round(mkeys / base_mkeys, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

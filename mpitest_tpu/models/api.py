@@ -6,18 +6,25 @@ it owns everything that is *not* SPMD — dtype encoding, padding to static
 shapes, placing shards on the mesh, compiling the shard_map program,
 reacting to exchange overflow, and decoding results back to the host.
 
-Static-shape contract: inputs pad to ``P·n`` with max-sentinel keys
-(+∞-like, SURVEY.md §7.4 "Scatter overflow" fix — padding also makes P∤N
-inputs correct, which the reference gets wrong).  Sentinels are *real*
-maximum keys, so they sort to the global tail and slicing the first N
-elements recovers the exact multiset — bit-identical output.
+Static-shape contract: inputs pad to ``P·n`` with copies of the *maximum
+real key* (SURVEY.md §7.4 "Scatter overflow" fix — padding also makes P∤N
+inputs correct, which the reference gets wrong).  Pads tie with genuine
+max keys and sort to the global tail, so slicing the first N elements
+recovers the exact multiset — bit-identical output — and, unlike an
+all-ones sentinel, pads never widen the key range seen by the radix
+pass planner.
 
 Overflow-retry contract: the SPMD programs return the global max per-peer
 segment length.  If it exceeded the static cap, lanes were dropped and the
-result is discarded; the host recompiles with the *exact* required cap
-(deterministic program ⇒ second run succeeds).  This replaces the
-reference's silent bucket overflow (``mpi_sample_sort.c:140-144``) and its
-"no enough sample" abort (``:96-99``) with a clean, always-correct path.
+result is discarded; the host recompiles with that length as the new cap
+and reruns.  For single-exchange sample sort the reported value is exact,
+so one retry suffices; for multi-pass radix an overflowed early pass
+corrupts what later passes see, so the reported max can understate a later
+pass's need — the cap still grows strictly monotonically (bounded by the
+shard size), so the loop terminates, possibly after more than one
+recompile.  This replaces the reference's silent bucket overflow
+(``mpi_sample_sort.c:140-144``) and its "no enough sample" abort
+(``:96-99``) with a clean, always-correct path.
 """
 
 from __future__ import annotations
@@ -29,11 +36,11 @@ from functools import lru_cache
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from mpitest_tpu.models import radix_sort, sample_sort
 from mpitest_tpu.ops.keys import codec_for
-from mpitest_tpu.parallel.mesh import AXIS, make_mesh
+from mpitest_tpu.parallel.mesh import AXIS, key_sharding, make_mesh
 from mpitest_tpu.utils.trace import Tracer
 
 
@@ -100,18 +107,27 @@ def _needed_passes(words: tuple[np.ndarray, ...], digit_bits: int) -> int:
     (msw first) with plain max/min reductions: the first word that is not
     constant decides — ``msb(max ^ min)`` within it, everything below it
     needs full coverage anyway.  O(N) reductions, no copies.
+
+    Digit alignment restarts at every 32-bit word boundary (the pass loop
+    in :func:`radix_sort_spmd` walks ``per_word`` digits per word), so the
+    count is ``per_word``-per-full-word plus the digits covering the
+    differing bits of the first non-constant word — NOT a contiguous
+    bit-count over the whole key, which would undercount whenever
+    ``digit_bits`` does not divide 32.
     """
     n_words = len(words)
     per_word = (32 + digit_bits - 1) // digit_bits
     if words[0].size == 0:
         return 0
-    total_bits = 0
     for wi, w in enumerate(words):  # msw first
         x = int(w.max()) ^ int(w.min())
         if x:
-            total_bits = (n_words - 1 - wi) * 32 + x.bit_length()
-            break
-    return min(math.ceil(total_bits / digit_bits), per_word * n_words)
+            full_words_below = n_words - 1 - wi
+            return min(
+                full_words_below * per_word + math.ceil(x.bit_length() / digit_bits),
+                per_word * n_words,
+            )
+    return 0
 
 
 @lru_cache(maxsize=64)
@@ -155,13 +171,13 @@ def _compile_sample(mesh: Mesh, n_words: int, n: int, cap: int, oversample: int)
     )
 
 
-def _shard_input(words_np, mesh, n, pad_words):
+def _shard_input(words_np, mesh, n, pad_words=None):
     P_ = mesh.devices.size
-    sharding = NamedSharding(mesh, P(AXIS))
+    sharding = key_sharding(mesh)
     out = []
-    for w, pad_val in zip(words_np, pad_words):
+    for i, w in enumerate(words_np):
         if w.size < P_ * n:
-            w = np.concatenate([w, np.full(P_ * n - w.size, pad_val, np.uint32)])
+            w = np.concatenate([w, np.full(P_ * n - w.size, pad_words[i], np.uint32)])
         out.append(jax.device_put(w, sharding))
     return tuple(out)
 
@@ -196,20 +212,22 @@ def sort(
     n = max(1, math.ceil(N / n_ranks))
 
     with tracer.phase("encode"):
-        words_np = codec.encode(x.reshape(-1))
-    sentinel = codec.max_sentinel()
+        flat = x.reshape(-1)
+        words_np = codec.encode(flat)
+        if N < n_ranks * n:
+            # Pad slots replicate the *maximum real key* (encode is
+            # order-preserving, so encoding the host max yields the
+            # lexicographically-max word tuple).
+            pad = tuple(int(w[0]) for w in codec.encode(np.asarray([flat.max()], dtype)))
+        else:
+            pad = None  # divisible N: no padding, skip the host max() scan
 
     with tracer.phase("device_put"):
-        words = _shard_input(words_np, mesh, n, sentinel)
+        words = _shard_input(words_np, mesh, n, pad)
 
     if algorithm == "radix":
         with tracer.phase("plan"):
-            # Padding sentinels participate in the sort, so plan over them too.
-            plan_words = words_np if N == n_ranks * n else tuple(
-                np.concatenate([w, np.asarray([s], np.uint32)])
-                for w, s in zip(words_np, sentinel)
-            )
-            passes = _needed_passes(plan_words, digit_bits)
+            passes = _needed_passes(words_np, digit_bits)
         cap = _round_cap(int(n / n_ranks * cap_factor) + 1)
         while True:
             fn = _compile_radix(mesh, codec.n_words, n, digit_bits, cap, passes)

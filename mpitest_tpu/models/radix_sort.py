@@ -110,8 +110,10 @@ def radix_sort_spmd(
     the least-significant digit of the least-significant word upward.
 
     Returns ``(sorted_words, max_send_cnt_over_passes)`` — the second value
-    > cap means an exchange overflowed and the host must retry with that
-    cap (deterministic, so the retry is exact).
+    > cap means an exchange overflowed and the host must retry with at
+    least that cap (an overflowed pass corrupts later passes, so the
+    reported value is a lower bound; the host loop grows the cap
+    monotonically until no pass overflows).
     """
     per_word = (32 + digit_bits - 1) // digit_bits
     total = per_word * n_words if passes is None else passes

@@ -36,7 +36,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from mpitest_tpu.ops import kernels
+from mpitest_tpu.ops import kernels, keys
 from mpitest_tpu.parallel import collectives as coll
 from mpitest_tpu.parallel.mesh import AXIS
 
@@ -83,7 +83,7 @@ def sample_sort_spmd(
     send_start = coll.exclusive_cumsum(h)
     send_cnt = h
 
-    sentinel = (0xFFFFFFFF,) * n_words
+    sentinel = (keys.MAX_WORD,) * n_words
     recv, recv_cnt, max_cnt = coll.ragged_all_to_all(
         sorted_words, send_start, send_cnt, cap, n_ranks, axis,
         fill=sentinel,

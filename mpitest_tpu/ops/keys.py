@@ -14,9 +14,13 @@ so negative keys sort by magnitude with the sign dropped.  The biased
 encoding here (sign-bit flip) makes signed sorts actually correct; the
 divergence is documented in SURVEY.md §7.4.
 
-Sentinel values: ``max_sentinel`` is the all-ones word tuple, which encodes
-to the maximum representable key and therefore sorts after every real key.
-Padding slots use it so static-shape sorts keep valid data as a prefix.
+Host-side padding (models/api.py) replicates the maximum *real* key, not a
+synthetic sentinel, so pads never widen the key range the radix pass
+planner sees.  The all-ones word :data:`MAX_WORD` is still used inside the
+SPMD programs as the fill for invalid exchange lanes (sample sort), where
+it guarantees fills sort to the tail of the static buffer; validity there
+is tracked by explicit counts, so collisions with real all-ones keys are
+harmless.
 """
 
 from __future__ import annotations
@@ -26,6 +30,9 @@ from dataclasses import dataclass
 import numpy as np
 
 _SIGN32 = np.uint32(0x80000000)
+
+#: All-ones uint32 word — exchange-lane fill that sorts to the tail.
+MAX_WORD = 0xFFFFFFFF
 
 
 @dataclass(frozen=True)
@@ -70,8 +77,9 @@ class KeyCodec:
         return u  # uint64
 
     def max_sentinel(self) -> tuple[int, ...]:
-        """Word values that encode the maximum key (sorts last)."""
-        return (0xFFFFFFFF,) * self.n_words
+        """Word values that encode the maximum representable key (sorts
+        last); the per-word exchange-lane fill (see :data:`MAX_WORD`)."""
+        return (MAX_WORD,) * self.n_words
 
 
 _CODECS = {

@@ -1,0 +1,55 @@
+"""Structured metrics sidecar — observability the reference lacks.
+
+The reference's entire machine-readable surface is two text lines (stdout
+median probe, stderr elapsed seconds — ``mpi_sample_sort.c:205,207``).
+This module adds the structured counterpart prescribed by SURVEY.md §5:
+throughput (Mkeys/s), per-phase milliseconds, bytes moved, and achieved
+collective bandwidth, emitted as one JSON object to a file or stream.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Metrics:
+    """Accumulates named measurements; one JSON object out."""
+
+    config: dict = field(default_factory=dict)
+    values: dict = field(default_factory=dict)
+
+    def record(self, name: str, value, unit: str | None = None) -> None:
+        self.values[name] = {"value": value, **({"unit": unit} if unit else {})}
+
+    def record_phases(self, phases: dict[str, float]) -> None:
+        """Fold a Tracer's phase→seconds map in as per-phase milliseconds."""
+        for name, secs in phases.items():
+            self.record(f"phase_{name}_ms", round(secs * 1e3, 3), "ms")
+
+    def throughput(self, name: str, n_keys: int, seconds: float) -> float:
+        mkeys = n_keys / seconds / 1e6
+        self.record(name, round(mkeys, 3), "Mkeys/s")
+        return mkeys
+
+    def bandwidth(self, name: str, n_bytes: int, seconds: float) -> float:
+        gbs = n_bytes / seconds / 1e9
+        self.record(name, round(gbs, 3), "GB/s")
+        return gbs
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {"ts": time.time(), "config": self.config, "metrics": self.values}
+        )
+
+    def dump(self, path: str | None = None) -> None:
+        """Append one JSON line to ``path``, or stderr when no path given."""
+        line = self.to_json()
+        if path:
+            with open(path, "a") as f:
+                f.write(line + "\n")
+        else:
+            print(line, file=sys.stderr)

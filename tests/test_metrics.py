@@ -1,0 +1,49 @@
+"""Metrics sidecar + pass-planner regression tests."""
+
+import json
+
+import numpy as np
+
+from mpitest_tpu.models.api import _needed_passes
+from mpitest_tpu.ops.keys import codec_for
+from mpitest_tpu.utils.metrics import Metrics
+
+
+def test_metrics_roundtrip(tmp_path):
+    m = Metrics(config={"algo": "radix", "n": 1024})
+    m.throughput("sort", 1_000_000, 0.5)
+    m.bandwidth("all_to_all", 8_000_000_000, 1.0)
+    m.record_phases({"sort": 0.25})
+    p = tmp_path / "metrics.jsonl"
+    m.dump(str(p))
+    obj = json.loads(p.read_text().strip())
+    assert obj["config"]["algo"] == "radix"
+    assert obj["metrics"]["sort"] == {"value": 2.0, "unit": "Mkeys/s"}
+    assert obj["metrics"]["all_to_all"] == {"value": 8.0, "unit": "GB/s"}
+    assert obj["metrics"]["phase_sort_ms"] == {"value": 250.0, "unit": "ms"}
+
+
+def test_needed_passes_word_alignment():
+    """digit_bits ∤ 32: passes restart at word boundaries, so keys differing
+    only in the high word must still cover the full low word (regression:
+    contiguous bit-count undercounts and leaves the high word unsorted)."""
+    codec = codec_for(np.int64)
+    words = codec.encode(np.array([2**32, 0], np.int64))
+    per_word = -(-32 // 12)  # 3
+    assert _needed_passes(words, 12) == per_word + 1  # low word fully + 1 digit
+
+    # 8-bit digits, int32: small range needs 1 pass (the sign-bias flip
+    # cancels in max^min for same-sign keys); mixed signs span bit 31 → 4.
+    c32 = codec_for(np.int32)
+    assert _needed_passes(c32.encode(np.array([0, 200], np.int32)), 8) == 1
+    assert _needed_passes(c32.encode(np.array([-1, 1], np.int32)), 8) == 4
+    assert _needed_passes(c32.encode(np.array([5, 5], np.int32)), 8) == 0
+
+
+def test_needed_passes_digit12_sorts_correctly(mesh8):
+    """End-to-end: non-divisor digit width on 64-bit keys (the bug case)."""
+    from mpitest_tpu.models.api import sort
+
+    x = np.array([2**32, 0, -(2**40), 7, 2**33 + 1, -1], np.int64)
+    got = sort(x, algorithm="radix", mesh=mesh8, digit_bits=12)
+    np.testing.assert_array_equal(got, np.sort(x))

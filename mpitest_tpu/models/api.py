@@ -39,6 +39,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from mpitest_tpu.models import radix_sort, sample_sort
+from mpitest_tpu.ops import kernels
 from mpitest_tpu.ops.keys import codec_for
 from mpitest_tpu.parallel.mesh import AXIS, key_sharding, make_mesh
 from mpitest_tpu.utils.trace import Tracer
@@ -130,6 +131,51 @@ def _needed_passes(words: tuple[np.ndarray, ...], digit_bits: int) -> int:
     return 0
 
 
+@lru_cache(maxsize=8)
+def _compile_local_device(dtype_name: str):
+    """1-device program for device-resident input: fused encode + sort."""
+    codec = codec_for(np.dtype(dtype_name))
+
+    def f(x):
+        return kernels.local_sort(codec.encode_jax(x))
+
+    return jax.jit(f)
+
+
+@lru_cache(maxsize=16)
+def _compile_encode_pad(dtype_name: str, total: int, mesh: Mesh | None):
+    """Device-side encode + pad-to-``total``-with-max.  With a mesh, the
+    output is sharded on the key axis; with ``mesh=None`` the program runs
+    wherever the input lives (used for non-divisible N, whose *input*
+    cannot be evenly sharded — the padded output can, and is landed on the
+    mesh by the caller).  Keeps device-resident keys off the host."""
+    codec = codec_for(np.dtype(dtype_name))
+
+    def f(x):
+        (w,) = codec.encode_jax(x)
+        pad = total - w.shape[0]
+        if pad:
+            w = jnp.concatenate([w, jnp.broadcast_to(jnp.max(w), (pad,))])
+        return w
+
+    if mesh is None:
+        return jax.jit(f)
+    return jax.jit(f, out_shardings=key_sharding(mesh))
+
+
+@lru_cache(maxsize=8)
+def _compile_local(n_words: int):
+    """The 1-device specialization: both distributed algorithms degenerate
+    to the local kernel when the mesh has a single device (no exchange, no
+    splitters, no digit passes) — one fused ``lax.sort``.  The reference
+    run with ``-np 1`` still pays its full protocol; here the program
+    specializes to what the hardware actually needs."""
+    def f(*words):
+        return kernels.local_sort(words)
+
+    return jax.jit(f)
+
+
 @lru_cache(maxsize=64)
 def _compile_radix(mesh: Mesh, n_words: int, n: int, digit_bits: int, cap: int,
                    passes: int):
@@ -198,36 +244,81 @@ def sort(
     ``algorithm``: ``"radix"`` (flagship: perfectly load-balanced, fixed
     pass count) or ``"sample"`` (one exchange round; cap-sensitive under
     skew).  Both produce identical bytes — sorted output is canonical.
+
+    ``x`` may be a host array OR a device-resident ``jax.Array`` (1-word
+    dtypes): the device path encodes/pads on-device and never round-trips
+    the keys through the host — the framework's steady-state contract
+    (keys live sharded on the mesh; SURVEY.md §5 long-context row).
     """
     tracer = tracer or Tracer()
-    x = np.asarray(x)
-    dtype = x.dtype
+    is_device = isinstance(x, jax.Array)
+    if not is_device:
+        x = np.asarray(x)
+    dtype = np.dtype(x.dtype)
     codec = codec_for(dtype)
-    N = x.size
+    N = int(x.size)
     if N == 0:
-        return x.copy() if not return_result else DistributedSortResult((), 0, dtype)
+        out = np.empty(0, dtype)
+        return out if not return_result else DistributedSortResult((), 0, dtype)
     if mesh is None:
         mesh = make_mesh()
     n_ranks = int(mesh.devices.size)
     n = max(1, math.ceil(N / n_ranks))
 
-    with tracer.phase("encode"):
-        flat = x.reshape(-1)
-        words_np = codec.encode(flat)
-        if N < n_ranks * n:
-            # Pad slots replicate the *maximum real key* (encode is
-            # order-preserving, so encoding the host max yields the
-            # lexicographically-max word tuple).
-            pad = tuple(int(w[0]) for w in codec.encode(np.asarray([flat.max()], dtype)))
+    if n_ranks == 1 and algorithm in ("radix", "sample"):
+        if is_device:
+            with tracer.phase("sort"):
+                out = _compile_local_device(dtype.name)(x.reshape(-1))
         else:
-            pad = None  # divisible N: no padding, skip the host max() scan
+            with tracer.phase("encode"):
+                words_np = codec.encode(x.reshape(-1))
+            with tracer.phase("device_put"):
+                words = tuple(
+                    jax.device_put(w, mesh.devices.flat[0]) for w in words_np
+                )
+            with tracer.phase("sort"):
+                out = _compile_local(codec.n_words)(*words)
+        res = DistributedSortResult(out, N, dtype)
+        if return_result:
+            return res
+        with tracer.phase("decode"):
+            return res.to_numpy()
 
-    with tracer.phase("device_put"):
-        words = _shard_input(words_np, mesh, n, pad)
+    if is_device:
+        words_np = None
+        with tracer.phase("encode"):
+            x_flat = x.reshape(-1)
+            if N == n_ranks * n:
+                # Land the input on the mesh first (no-op when already
+                # sharded there); a committed single-device array would
+                # otherwise conflict with the jit's mesh-wide out_shardings.
+                x_flat = jax.device_put(x_flat, key_sharding(mesh))
+                words = (_compile_encode_pad(dtype.name, N, mesh)(x_flat),)
+            else:
+                # Uneven N cannot be mesh-sharded directly; encode+pad
+                # wherever the input lives, then land the even result.
+                w = _compile_encode_pad(dtype.name, n_ranks * n, None)(x_flat)
+                words = (jax.device_put(w, key_sharding(mesh)),)
+    else:
+        with tracer.phase("encode"):
+            flat = x.reshape(-1)
+            words_np = codec.encode(flat)
+            if N < n_ranks * n:
+                # Pad slots replicate the *maximum real key* (encode is
+                # order-preserving, so encoding the host max yields the
+                # lexicographically-max word tuple).
+                pad = tuple(int(w[0]) for w in codec.encode(np.asarray([flat.max()], dtype)))
+            else:
+                pad = None  # divisible N: no padding, skip the host max() scan
+
+        with tracer.phase("device_put"):
+            words = _shard_input(words_np, mesh, n, pad)
 
     if algorithm == "radix":
         with tracer.phase("plan"):
-            passes = _needed_passes(words_np, digit_bits)
+            # Device-resident input: no host view of the keys, so run the
+            # full pass schedule rather than sync a min/max back.
+            passes = None if words_np is None else _needed_passes(words_np, digit_bits)
         cap = _round_cap(int(n / n_ranks * cap_factor) + 1)
         while True:
             fn = _compile_radix(mesh, codec.n_words, n, digit_bits, cap, passes)

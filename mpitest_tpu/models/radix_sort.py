@@ -56,40 +56,58 @@ Words = tuple[jax.Array, ...]
 
 def _one_pass(words: Words, word_idx: int, shift: int, digit_bits: int,
               n_ranks: int, cap: int, axis: str) -> tuple[Words, jax.Array]:
+    """One LSD pass, built only from TPU-fast primitives: fused multi-
+    operand ``lax.sort``, ``searchsorted`` over sorted data, cumsum, and
+    K-element scatters (K = bins or ranks).  Per-element gathers/scatters
+    — the straightforward translation of the reference's bucket loops —
+    measured 10-40× slower than a sort at 2^26 on v5e, so none appear on
+    the per-key path."""
     n = words[0].shape[0]
     n_bins = 1 << digit_bits
     my = lax.axis_index(axis)
 
+    # Group keys by digit: ONE fused stable sort carries all key words.
     d = kernels.digit_at(words[word_idx], shift, digit_bits)
-    h = kernels.histogram(d, n_bins)
+    ops = lax.sort([d] + list(words), num_keys=1, is_stable=True)
+    sd, sorted_words = ops[0], tuple(ops[1:])
+
+    # Histogram + first-occurrence offsets from the sorted digits (no scatter).
+    h, lo = kernels.histogram_sorted(sd, n_bins)
+
     _, tot, rank_base = coll.exscan_counts(h, axis)
     digit_base = coll.exclusive_cumsum(tot)
-    base = digit_base + rank_base[my]
+    base = digit_base + rank_base[my]          # [bins] my global run starts
 
-    perm, sd = kernels.stable_rank_by_digit(d)
-    sorted_words = tuple(w[perm] for w in words)
-    local_start = coll.exclusive_cumsum(h)
-    j = lax.iota(jnp.int32, n)
-    dest = base[sd] + (j - local_start[sd])
+    # dest[j] = base[sd[j]] + (j - lo[sd[j]]): the step function
+    # (base - lo)[sd[j]] materialized gather-free, plus iota.
+    dest = kernels.piecewise_fill(lo, base - lo, n) + lax.iota(jnp.int32, n)
 
     bounds = lax.iota(jnp.int32, n_ranks) * n
     send_start = jnp.searchsorted(dest, bounds, side="left").astype(jnp.int32)
     seg_end = jnp.concatenate([send_start[1:], jnp.asarray([n], jnp.int32)])
     send_cnt = seg_end - send_start
 
-    payload = tuple(list(sorted_words) + [dest])
+    # Keys only on the wire — the receiver recomputes digits from the key
+    # words, so no index payload rides the exchange.
     recv, recv_cnt, max_cnt = coll.ragged_all_to_all(
-        payload, send_start, send_cnt, cap, n_ranks, axis
+        sorted_words, send_start, send_cnt, cap, n_ranks, axis
     )
-    rwords, rdest = recv[:-1], recv[-1]
 
+    # Receiver-side placement is a P-way merge by (digit, sender, arrival):
+    # flatten sender-major and stable-sort by digit.  Globally, my n slots
+    # are filled exactly once (dest partitions [0, P·n)), so the valid
+    # lanes sort to a length-n prefix; invalid lanes get digit = n_bins.
+    # This replaces the reference's rank-ordered Recv loop
+    # (mpi_radix_sort.c:168-173) and needs no per-element scatter.
+    rd = kernels.digit_at(recv[word_idx], shift, digit_bits)
     c = lax.iota(jnp.int32, cap)
     valid = c[None, :] < recv_cnt[:, None]                           # [P, cap]
-    local_off = jnp.where(valid, rdest - my * n, n).reshape(-1)      # n = drop slot
-    out_words = tuple(
-        jnp.zeros((n,), w.dtype).at[local_off].set(w.reshape(-1), mode="drop")
-        for w in rwords
+    rd = jnp.where(valid, rd, n_bins)
+    flat = lax.sort(
+        [rd.reshape(-1)] + [r.reshape(-1) for r in recv],
+        num_keys=1, is_stable=True,
     )
+    out_words = tuple(o[:n] for o in flat[1:])
     return out_words, max_cnt
 
 

@@ -76,6 +76,30 @@ class KeyCodec:
             return (u ^ np.uint64(0x8000000000000000)).view(np.int64)
         return u  # uint64
 
+    def encode_jax(self, x):
+        """Device-side encode for 1-word dtypes (int32/uint32): bitcast +
+        sign-bias XOR, elementwise — XLA fuses it into the consumer sort.
+        64-bit dtypes need the host path (TPU JAX runs without x64)."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        if self.dtype == np.dtype(np.int32):
+            return (lax.bitcast_convert_type(x, jnp.uint32) ^ jnp.uint32(0x80000000),)
+        if self.dtype == np.dtype(np.uint32):
+            return (x,)
+        raise TypeError(f"device-side encode unsupported for {self.dtype}")
+
+    def decode_jax(self, words):
+        """Inverse of :meth:`encode_jax` (1-word dtypes only)."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        if self.dtype == np.dtype(np.int32):
+            return lax.bitcast_convert_type(words[0] ^ jnp.uint32(0x80000000), jnp.int32)
+        if self.dtype == np.dtype(np.uint32):
+            return words[0]
+        raise TypeError(f"device-side decode unsupported for {self.dtype}")
+
     def max_sentinel(self) -> tuple[int, ...]:
         """Word values that encode the maximum representable key (sorts
         last); the per-word exchange-lane fill (see :data:`MAX_WORD`)."""

@@ -1,0 +1,68 @@
+"""Unit tests for the gather-free kernel building blocks + 1-device path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpitest_tpu.models.api import sort
+from mpitest_tpu.ops import kernels
+from mpitest_tpu.parallel.mesh import make_mesh
+
+
+def test_piecewise_fill_basic():
+    starts = jnp.asarray([0, 3, 3, 7], jnp.int32)   # empty segment at k=1→2
+    values = jnp.asarray([5, 2, 9, -4], jnp.int32)
+    out = np.asarray(jax.jit(kernels.piecewise_fill, static_argnums=2)(starts, values, 10))
+    #           j: 0  1  2  3  4  5  6   7   8   9
+    expect = np.array([5, 5, 5, 9, 9, 9, 9, -4, -4, -4], np.int32)
+    np.testing.assert_array_equal(out, expect)
+
+
+def test_piecewise_fill_tail_at_n():
+    starts = jnp.asarray([0, 4, 4], jnp.int32)      # start == n tail segments
+    values = jnp.asarray([1, 7, 8], jnp.int32)
+    out = np.asarray(jax.jit(kernels.piecewise_fill, static_argnums=2)(starts, values, 4))
+    np.testing.assert_array_equal(out, np.array([1, 1, 1, 1], np.int32))
+
+
+def test_histogram_sorted_matches_scatter():
+    rng = np.random.default_rng(0)
+    d = np.sort(rng.integers(0, 256, 5000).astype(np.int32))
+    h, lo = jax.jit(kernels.histogram_sorted, static_argnums=1)(jnp.asarray(d), 256)
+    expect = np.bincount(d, minlength=256)
+    np.testing.assert_array_equal(np.asarray(h), expect)
+    np.testing.assert_array_equal(np.asarray(lo), np.concatenate([[0], np.cumsum(expect)[:-1]]))
+
+
+@pytest.mark.parametrize("algo", ["radix", "sample"])
+def test_device_resident_input_multi_device(algo, mesh8, rng):
+    """Device-resident jax.Array input on a multi-device mesh: sharded,
+    committed-to-one-device, and non-divisible-N variants."""
+    from mpitest_tpu.parallel.mesh import key_sharding
+
+    x = rng.integers(-(2**31), 2**31 - 1, size=8 * 512, dtype=np.int32)
+    ref = np.sort(x)
+
+    x_sharded = jax.device_put(x, key_sharding(mesh8))
+    np.testing.assert_array_equal(sort(x_sharded, algorithm=algo, mesh=mesh8), ref)
+
+    x_committed = jax.device_put(x, jax.devices("cpu")[0])
+    np.testing.assert_array_equal(sort(x_committed, algorithm=algo, mesh=mesh8), ref)
+
+    y = rng.integers(0, 2**32, size=1003, dtype=np.uint32)
+    y_dev = jax.device_put(y, jax.devices("cpu")[0])
+    np.testing.assert_array_equal(sort(y_dev, algorithm=algo, mesh=mesh8), np.sort(y))
+
+
+@pytest.mark.parametrize("algo", ["radix", "sample"])
+@pytest.mark.parametrize("dtype", [np.int32, np.int64])
+def test_single_device_mesh_fast_path(algo, dtype, rng):
+    """1-device mesh: both algorithms specialize to the local fused sort."""
+    mesh1 = make_mesh(1)
+    info = np.iinfo(np.dtype(dtype))
+    x = rng.integers(info.min, info.max, size=10_001, dtype=dtype, endpoint=True)
+    got = sort(x, algorithm=algo, mesh=mesh1)
+    np.testing.assert_array_equal(got, np.sort(x))
+    res = sort(x, algorithm=algo, mesh=mesh1, return_result=True)
+    assert res.median_probe() == int(np.sort(x)[x.size // 2 - 1])

@@ -1,0 +1,93 @@
+/* comm.h — the communication-backend shim (the BASELINE.json north star).
+ *
+ * Every communication step both sort programs need, factored behind one
+ * tiny API so the algorithms are backend-agnostic.  This is the surface
+ * SURVEY.md §2.3 censuses from the reference's raw MPI calls
+ * (mpi_sample_sort.c / mpi_radix_sort.c), redesigned:
+ *
+ *   - no hand-rolled collectives from Isend/Recv, no payload-length-in-
+ *     message-tag tricks (mpi_sample_sort.c:159-171), no unwaited
+ *     requests (mpi_sample_sort.c:37) — counts travel as data and every
+ *     transfer completes before the call returns;
+ *   - variable-size distribution is first-class (scatterv/gatherv/
+ *     alltoallv with explicit counts), fixing the reference's
+ *     equal-chunk Scatter overflow when P does not divide N
+ *     (mpi_sample_sort.c:72-82);
+ *   - SPMD entry is comm_launch(), so one binary runs identically over
+ *     OS processes (MPI backend, via mpirun) or shared-memory threads
+ *     (local backend, COMM_RANKS env — how this repo's CI runs without
+ *     an MPI installation).
+ *
+ * Backends: comm_local.c (pthreads + shared memory), comm_mpi.c (thin
+ * passthrough to an MPI library).  The TPU backend is the Python/JAX
+ * package (mpitest_tpu.parallel.collectives) — same logical surface over
+ * XLA collectives on an ICI mesh; drivers/sort_cli.py is its driver.
+ */
+#ifndef COMM_H
+#define COMM_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct comm_ctx comm_ctx; /* opaque per-rank handle */
+
+/* SPMD entry: run fn(ctx, arg) on every rank.  Rank count comes from the
+ * backend (COMM_RANKS env for local; mpirun -np for MPI).  Returns 0 on
+ * normal completion, nonzero on launch failure; comm_abort never returns
+ * here — it terminates the whole job with its code directly. */
+int comm_launch(void (*fn)(comm_ctx *, void *), void *arg);
+
+int comm_rank(const comm_ctx *c);
+int comm_size(const comm_ctx *c);
+
+/* Monotonic wall clock in seconds (MPI_Wtime contract). */
+double comm_wtime(void);
+
+/* Print message to stderr and terminate ALL ranks with `code`
+ * (MPI_Abort contract — fail-fast, §5 failure-detection row). */
+void comm_abort(comm_ctx *c, int code, const char *msg);
+
+void comm_barrier(comm_ctx *c);
+
+/* Rooted collectives.  `bytes` are per-element payload sizes × counts,
+ * i.e. plain byte counts; element typing is the caller's business. */
+void comm_bcast(comm_ctx *c, void *buf, size_t bytes, int root);
+
+/* Equal-chunk scatter/gather: `bytes` per rank. */
+void comm_scatter(comm_ctx *c, const void *send, void *recv, size_t bytes,
+                  int root);
+void comm_gather(comm_ctx *c, const void *send, void *recv, size_t bytes,
+                 int root);
+
+/* Variable-size: counts/displs are per-rank BYTE counts/offsets, valid on
+ * the root (scatterv: send side; gatherv: recv side). */
+void comm_scatterv(comm_ctx *c, const void *send, const size_t *counts,
+                   const size_t *displs, void *recv, size_t recv_bytes,
+                   int root);
+void comm_gatherv(comm_ctx *c, const void *send, size_t send_bytes,
+                  void *recv, const size_t *counts, const size_t *displs,
+                  int root);
+
+/* Every rank gets every rank's `bytes`-sized block, rank-major. */
+void comm_allgather(comm_ctx *c, const void *send, void *recv, size_t bytes);
+
+/* Fixed-size all-to-all: block i of `send` goes to rank i; block s of
+ * `recv` came from rank s.  `bytes` per block. */
+void comm_alltoall(comm_ctx *c, const void *send, void *recv, size_t bytes);
+
+/* Variable all-to-all with EXPLICIT counts (the reference smuggled
+ * lengths through message tags; here they are arguments).  All arrays
+ * are per-peer byte counts/offsets into send/recv. */
+void comm_alltoallv(comm_ctx *c, const void *send, const size_t *scounts,
+                    const size_t *sdispls, void *recv, const size_t *rcounts,
+                    const size_t *rdispls);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* COMM_H */

@@ -1,0 +1,199 @@
+/* comm_local.c — shared-memory backend: P ranks as pthreads, collectives
+ * by direct memcpy through published pointers.
+ *
+ * This is the moral equivalent of MPI's shared-memory (vader/sm)
+ * transport, which is what the reference actually exercised when run as
+ * `mpirun -np P` on one host — minus the MPI installation requirement.
+ * Rank count comes from the COMM_RANKS env var (default 4).
+ *
+ * Synchronization model: every collective is two barrier epochs —
+ * (1) publish: each participating rank stores its buffer/metadata
+ * pointers into per-rank slots, barrier; (2) copy: readers memcpy what
+ * they need from peers' published buffers, barrier.  The second barrier
+ * keeps publishers' buffers alive until all readers finish.  No
+ * reordering hazards: pthread_barrier_wait is a full memory fence.
+ * Race-free by construction — the reference's unwaited-Isend /
+ * ANY_SOURCE hazards (SURVEY.md §5 race-detection row) cannot be
+ * expressed in this API.
+ */
+#define _GNU_SOURCE
+#include "comm.h"
+
+#include <pthread.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+
+typedef struct {
+    const void *ptr;          /* published data */
+    const size_t *counts;     /* published byte counts (v-collectives) */
+    const size_t *displs;     /* published byte offsets (v-collectives) */
+} slot_t;
+
+typedef struct world {
+    int nranks;
+    pthread_barrier_t bar;
+    slot_t *slots;            /* [nranks] */
+} world_t;
+
+struct comm_ctx {
+    world_t *w;
+    int rank;
+};
+
+typedef struct {
+    world_t *w;
+    int rank;
+    void (*fn)(comm_ctx *, void *);
+    void *arg;
+} thread_arg_t;
+
+int comm_rank(const comm_ctx *c) { return c->rank; }
+int comm_size(const comm_ctx *c) { return c->w->nranks; }
+
+double comm_wtime(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (double)ts.tv_sec + 1e-9 * (double)ts.tv_nsec;
+}
+
+void comm_abort(comm_ctx *c, int code, const char *msg) {
+    if (msg) fprintf(stderr, "%s\n", msg);
+    (void)c;
+    exit(code ? code : 1); /* whole process: all ranks die (MPI_Abort) */
+}
+
+void comm_barrier(comm_ctx *c) { pthread_barrier_wait(&c->w->bar); }
+
+static slot_t *my_slot(comm_ctx *c) { return &c->w->slots[c->rank]; }
+
+void comm_bcast(comm_ctx *c, void *buf, size_t bytes, int root) {
+    if (c->rank == root) my_slot(c)->ptr = buf;
+    comm_barrier(c);
+    if (c->rank != root) memcpy(buf, c->w->slots[root].ptr, bytes);
+    comm_barrier(c);
+}
+
+void comm_scatter(comm_ctx *c, const void *send, void *recv, size_t bytes,
+                  int root) {
+    if (c->rank == root) my_slot(c)->ptr = send;
+    comm_barrier(c);
+    const char *base = (const char *)c->w->slots[root].ptr;
+    memcpy(recv, base + (size_t)c->rank * bytes, bytes);
+    comm_barrier(c);
+}
+
+void comm_scatterv(comm_ctx *c, const void *send, const size_t *counts,
+                   const size_t *displs, void *recv, size_t recv_bytes,
+                   int root) {
+    if (c->rank == root) {
+        my_slot(c)->ptr = send;
+        my_slot(c)->counts = counts;
+        my_slot(c)->displs = displs;
+    }
+    comm_barrier(c);
+    const slot_t *rs = &c->w->slots[root];
+    size_t n = rs->counts[c->rank];
+    if (n > recv_bytes) n = recv_bytes;
+    memcpy(recv, (const char *)rs->ptr + rs->displs[c->rank], n);
+    comm_barrier(c);
+}
+
+void comm_gather(comm_ctx *c, const void *send, void *recv, size_t bytes,
+                 int root) {
+    my_slot(c)->ptr = send;
+    comm_barrier(c);
+    if (c->rank == root) {
+        for (int s = 0; s < c->w->nranks; s++)
+            memcpy((char *)recv + (size_t)s * bytes, c->w->slots[s].ptr, bytes);
+    }
+    comm_barrier(c);
+}
+
+void comm_gatherv(comm_ctx *c, const void *send, size_t send_bytes,
+                  void *recv, const size_t *counts, const size_t *displs,
+                  int root) {
+    my_slot(c)->ptr = send;
+    (void)send_bytes;
+    comm_barrier(c);
+    if (c->rank == root) {
+        for (int s = 0; s < c->w->nranks; s++)
+            memcpy((char *)recv + displs[s], c->w->slots[s].ptr, counts[s]);
+    }
+    comm_barrier(c);
+}
+
+void comm_allgather(comm_ctx *c, const void *send, void *recv, size_t bytes) {
+    my_slot(c)->ptr = send;
+    comm_barrier(c);
+    for (int s = 0; s < c->w->nranks; s++)
+        memcpy((char *)recv + (size_t)s * bytes, c->w->slots[s].ptr, bytes);
+    comm_barrier(c);
+}
+
+void comm_alltoall(comm_ctx *c, const void *send, void *recv, size_t bytes) {
+    my_slot(c)->ptr = send;
+    comm_barrier(c);
+    for (int s = 0; s < c->w->nranks; s++)
+        memcpy((char *)recv + (size_t)s * bytes,
+               (const char *)c->w->slots[s].ptr + (size_t)c->rank * bytes,
+               bytes);
+    comm_barrier(c);
+}
+
+void comm_alltoallv(comm_ctx *c, const void *send, const size_t *scounts,
+                    const size_t *sdispls, void *recv, const size_t *rcounts,
+                    const size_t *rdispls) {
+    slot_t *s = my_slot(c);
+    s->ptr = send;
+    s->counts = scounts;
+    s->displs = sdispls;
+    comm_barrier(c);
+    for (int p = 0; p < c->w->nranks; p++) {
+        const slot_t *ps = &c->w->slots[p];
+        size_t n = ps->counts[c->rank];
+        if (n > rcounts[p]) n = rcounts[p];
+        memcpy((char *)recv + rdispls[p],
+               (const char *)ps->ptr + ps->displs[c->rank], n);
+    }
+    comm_barrier(c);
+}
+
+static void *thread_main(void *va) {
+    thread_arg_t *ta = (thread_arg_t *)va;
+    comm_ctx ctx = {ta->w, ta->rank};
+    ta->fn(&ctx, ta->arg);
+    return NULL;
+}
+
+int comm_launch(void (*fn)(comm_ctx *, void *), void *arg) {
+    const char *env = getenv("COMM_RANKS");
+    int nranks = env ? atoi(env) : 4;
+    if (nranks < 1 || nranks > 1024) {
+        fprintf(stderr, "comm_local: bad COMM_RANKS=%s\n", env ? env : "");
+        return 1;
+    }
+    world_t w;
+    w.nranks = nranks;
+    w.slots = (slot_t *)calloc((size_t)nranks, sizeof(slot_t));
+    if (!w.slots || pthread_barrier_init(&w.bar, NULL, (unsigned)nranks)) {
+        fprintf(stderr, "comm_local: init failed\n");
+        return 1;
+    }
+    pthread_t *tids = (pthread_t *)calloc((size_t)nranks, sizeof(pthread_t));
+    thread_arg_t *tas = (thread_arg_t *)calloc((size_t)nranks, sizeof(thread_arg_t));
+    for (int r = 0; r < nranks; r++) {
+        tas[r] = (thread_arg_t){&w, r, fn, arg};
+        if (pthread_create(&tids[r], NULL, thread_main, &tas[r])) {
+            fprintf(stderr, "comm_local: pthread_create failed\n");
+            exit(1);
+        }
+    }
+    for (int r = 0; r < nranks; r++) pthread_join(tids[r], NULL);
+    pthread_barrier_destroy(&w.bar);
+    free(tids);
+    free(tas);
+    free(w.slots);
+    return 0;
+}

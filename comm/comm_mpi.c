@@ -1,0 +1,134 @@
+/* comm_mpi.c — thin MPI passthrough backend for comm.h.
+ *
+ * Every call maps to the matching real MPI collective — none of the
+ * reference's hand-rolled emulations survive (SURVEY.md §2.3): its
+ * Isend-per-peer Bcast (mpi_sample_sort.c:63-69), tag-as-length
+ * Alltoallv (:159-171) and ANY_SOURCE collection (:167) become plain
+ * MPI_Bcast / MPI_Alltoallv with explicit counts, so there are no
+ * unwaited requests and no nondeterministic arrival orders.
+ *
+ * Build with `make BACKEND=mpi` (requires an MPI toolchain; the CI image
+ * for this repo has none, so the local backend is the default there).
+ *
+ * Counts/displacements: comm.h traffics in size_t bytes; MPI wants int
+ * element counts.  We transfer MPI_BYTE and range-check the casts.
+ */
+#include "comm.h"
+
+#include <mpi.h>
+#include <limits.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+struct comm_ctx {
+    int rank, size;
+};
+
+static int chk_int(size_t v) {
+    if (v > (size_t)INT_MAX) {
+        fprintf(stderr, "comm_mpi: byte count %zu exceeds INT_MAX\n", v);
+        MPI_Abort(MPI_COMM_WORLD, 1);
+    }
+    return (int)v;
+}
+
+int comm_rank(const comm_ctx *c) { return c->rank; }
+int comm_size(const comm_ctx *c) { return c->size; }
+double comm_wtime(void) { return MPI_Wtime(); }
+
+void comm_abort(comm_ctx *c, int code, const char *msg) {
+    if (msg) fprintf(stderr, "%s\n", msg);
+    (void)c;
+    MPI_Abort(MPI_COMM_WORLD, code ? code : 1);
+}
+
+void comm_barrier(comm_ctx *c) { (void)c; MPI_Barrier(MPI_COMM_WORLD); }
+
+void comm_bcast(comm_ctx *c, void *buf, size_t bytes, int root) {
+    (void)c;
+    MPI_Bcast(buf, chk_int(bytes), MPI_BYTE, root, MPI_COMM_WORLD);
+}
+
+void comm_scatter(comm_ctx *c, const void *send, void *recv, size_t bytes,
+                  int root) {
+    (void)c;
+    MPI_Scatter((void *)send, chk_int(bytes), MPI_BYTE, recv, chk_int(bytes),
+                MPI_BYTE, root, MPI_COMM_WORLD);
+}
+
+static int *to_int_array(const size_t *v, int n) {
+    int *out = (int *)malloc((size_t)n * sizeof(int));
+    for (int i = 0; i < n; i++) out[i] = chk_int(v[i]);
+    return out;
+}
+
+void comm_scatterv(comm_ctx *c, const void *send, const size_t *counts,
+                   const size_t *displs, void *recv, size_t recv_bytes,
+                   int root) {
+    int *ic = NULL, *id = NULL;
+    if (c->rank == root) {
+        ic = to_int_array(counts, c->size);
+        id = to_int_array(displs, c->size);
+    }
+    MPI_Scatterv((void *)send, ic, id, MPI_BYTE, recv, chk_int(recv_bytes),
+                 MPI_BYTE, root, MPI_COMM_WORLD);
+    free(ic);
+    free(id);
+}
+
+void comm_gather(comm_ctx *c, const void *send, void *recv, size_t bytes,
+                 int root) {
+    (void)c;
+    MPI_Gather((void *)send, chk_int(bytes), MPI_BYTE, recv, chk_int(bytes),
+               MPI_BYTE, root, MPI_COMM_WORLD);
+}
+
+void comm_gatherv(comm_ctx *c, const void *send, size_t send_bytes,
+                  void *recv, const size_t *counts, const size_t *displs,
+                  int root) {
+    int *ic = NULL, *id = NULL;
+    if (c->rank == root) {
+        ic = to_int_array(counts, c->size);
+        id = to_int_array(displs, c->size);
+    }
+    MPI_Gatherv((void *)send, chk_int(send_bytes), MPI_BYTE, recv, ic, id,
+                MPI_BYTE, root, MPI_COMM_WORLD);
+    free(ic);
+    free(id);
+}
+
+void comm_allgather(comm_ctx *c, const void *send, void *recv, size_t bytes) {
+    (void)c;
+    MPI_Allgather((void *)send, chk_int(bytes), MPI_BYTE, recv,
+                  chk_int(bytes), MPI_BYTE, MPI_COMM_WORLD);
+}
+
+void comm_alltoall(comm_ctx *c, const void *send, void *recv, size_t bytes) {
+    (void)c;
+    MPI_Alltoall((void *)send, chk_int(bytes), MPI_BYTE, recv,
+                 chk_int(bytes), MPI_BYTE, MPI_COMM_WORLD);
+}
+
+void comm_alltoallv(comm_ctx *c, const void *send, const size_t *scounts,
+                    const size_t *sdispls, void *recv, const size_t *rcounts,
+                    const size_t *rdispls) {
+    int n = c->size;
+    int *isc = to_int_array(scounts, n), *isd = to_int_array(sdispls, n);
+    int *irc = to_int_array(rcounts, n), *ird = to_int_array(rdispls, n);
+    MPI_Alltoallv((void *)send, isc, isd, MPI_BYTE, recv, irc, ird, MPI_BYTE,
+                  MPI_COMM_WORLD);
+    free(isc);
+    free(isd);
+    free(irc);
+    free(ird);
+}
+
+int comm_launch(void (*fn)(comm_ctx *, void *), void *arg) {
+    MPI_Init(NULL, NULL);
+    comm_ctx ctx;
+    MPI_Comm_rank(MPI_COMM_WORLD, &ctx.rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &ctx.size);
+    fn(&ctx, arg);
+    MPI_Finalize();
+    return 0;
+}

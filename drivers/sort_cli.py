@@ -1,0 +1,97 @@
+#!/usr/bin/env python3
+"""CLI driver — the reference's argv/stdout/stderr contract on the TPU backend.
+
+Byte-compatible machine interface (SURVEY.md §5 metrics row):
+
+* argv: ``sort_cli.py <datafile> [debug]`` — positional, like the
+  reference ``main()`` (``mpi_sample_sort.c:220-241``); wrong argc prints
+  ``Usage: %s <file: Data file to read>`` to stderr and exits non-zero
+  (``:230-234``), unreadable file prints ``sort(): '<file>' is not a
+  valid file for read.`` (``:46-48``).
+* stdout: ``Each bucket will be put %u items.`` (sample algorithm,
+  ``:74``), full ``%u|%u`` dump at debug>2 (``:203``), and the
+  correctness probe ``The n/2-th sorted element: %d`` (``:205``).
+* stderr: ``Endtime()-Starttime() = %.5f sec`` (``:207``), spanning
+  after-file-read to result materialization, like the reference's
+  ``MPI_Wtime`` pair (``:61,201``).
+
+Knobs the reference put in ``mpirun -np``/source constants ride env vars
+here: ``SORT_ALGO`` ∈ {sample, radix} (default sample — the reference
+binary of the same name), ``SORT_RANKS`` (mesh size; default all
+devices), ``SORT_DIGIT_BITS`` (radix digit width, default 8),
+``SORT_DTYPE`` (default int32).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv if argv is None else argv
+    if len(argv) not in (2, 3):
+        print(f"Usage: {argv[0]} <file: Data file to read>", file=sys.stderr)
+        return 1
+    path = argv[1]
+    # atoi() semantics, like the reference (mpi_sample_sort.c:237):
+    # non-numeric debug arg parses as 0, never crashes.
+    debug = 0
+    if len(argv) == 3:
+        import re
+
+        m = re.match(r"\s*[+-]?\d+", argv[2])
+        debug = int(m.group()) if m else 0
+
+    from mpitest_tpu.models.api import sort
+    from mpitest_tpu.parallel.mesh import make_mesh
+    from mpitest_tpu.utils.io import read_keys_text
+    from mpitest_tpu.utils.trace import Tracer
+
+    tracer = Tracer(level=debug)
+    algo = os.environ.get("SORT_ALGO", "sample")
+    dtype = np.dtype(os.environ.get("SORT_DTYPE", "int32"))
+    digit_bits = int(os.environ.get("SORT_DIGIT_BITS", "8"))
+    ranks = os.environ.get("SORT_RANKS")
+
+    try:
+        keys = read_keys_text(path, dtype=dtype)
+    except (OSError, ValueError):
+        print(f"sort(): '{path}' is not a valid file for read.", file=sys.stderr)
+        return 1
+    n = keys.size
+    if n == 0:
+        print(f"sort(): '{path}' is not a valid file for read.", file=sys.stderr)
+        return 1
+
+    mesh = make_mesh(int(ranks) if ranks else None)
+    n_ranks = int(mesh.devices.size)
+    tracer.common(f"Working 0/{n_ranks}", min_level=2)
+
+    if algo == "sample":
+        # ceil(N/P): the reference's size_bucket line (mpi_sample_sort.c:74).
+        print(f"Each bucket will be put {-(-n // n_ranks)} items.")
+
+    start = time.perf_counter()  # after file read, like MPI_Wtime at :61
+    res = sort(
+        keys, algorithm=algo, mesh=mesh, digit_bits=digit_bits,
+        tracer=tracer, return_result=True,
+    )
+    out = res.to_numpy()  # materialize = the reference's final Gatherv
+    end = time.perf_counter()
+
+    if debug > 2:
+        mask = (1 << (8 * dtype.itemsize)) - 1
+        for i, v in enumerate(out):
+            print(f"{i}|{int(v) & mask}")
+    # The reference indexes size_input/2 - 1 (UB for n == 1; we clamp).
+    print(f"The n/2-th sorted element: {int(out[max(n // 2 - 1, 0)])}")
+    print(f"Endtime()-Starttime() = {end - start:.5f} sec", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -132,6 +132,20 @@ def _needed_passes(words: tuple[np.ndarray, ...], digit_bits: int) -> int:
 
 
 @lru_cache(maxsize=8)
+def _compile_word_range(dtype_name: str):
+    """min/max of the encoded word — feeds the pass planner for
+    device-resident input (one tiny reduction + scalar sync instead of
+    abandoning pass skipping)."""
+    codec = codec_for(np.dtype(dtype_name))
+
+    def f(x):
+        (w,) = codec.encode_jax(x)
+        return jnp.min(w), jnp.max(w)
+
+    return jax.jit(f)
+
+
+@lru_cache(maxsize=8)
 def _compile_local_device(dtype_name: str):
     """1-device program for device-resident input: fused encode + sort."""
     codec = codec_for(np.dtype(dtype_name))
@@ -316,9 +330,15 @@ def sort(
 
     if algorithm == "radix":
         with tracer.phase("plan"):
-            # Device-resident input: no host view of the keys, so run the
-            # full pass schedule rather than sync a min/max back.
-            passes = None if words_np is None else _needed_passes(words_np, digit_bits)
+            if words_np is None:
+                # Device-resident input: one scalar min/max sync plans the
+                # pass count (pads replicate the max key — range unchanged).
+                wmin, wmax = _compile_word_range(dtype.name)(x.reshape(-1))
+                diff = int(wmin) ^ int(wmax)
+                per_word = (32 + digit_bits - 1) // digit_bits
+                passes = min(math.ceil(diff.bit_length() / digit_bits), per_word)
+            else:
+                passes = _needed_passes(words_np, digit_bits)
         cap = _round_cap(int(n / n_ranks * cap_factor) + 1)
         while True:
             fn = _compile_radix(mesh, codec.n_words, n, digit_bits, cap, passes)

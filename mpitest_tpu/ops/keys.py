@@ -89,17 +89,6 @@ class KeyCodec:
             return (x,)
         raise TypeError(f"device-side encode unsupported for {self.dtype}")
 
-    def decode_jax(self, words):
-        """Inverse of :meth:`encode_jax` (1-word dtypes only)."""
-        import jax.numpy as jnp
-        from jax import lax
-
-        if self.dtype == np.dtype(np.int32):
-            return lax.bitcast_convert_type(words[0] ^ jnp.uint32(0x80000000), jnp.int32)
-        if self.dtype == np.dtype(np.uint32):
-            return words[0]
-        raise TypeError(f"device-side decode unsupported for {self.dtype}")
-
     def max_sentinel(self) -> tuple[int, ...]:
         """Word values that encode the maximum representable key (sorts
         last); the per-word exchange-lane fill (see :data:`MAX_WORD`)."""

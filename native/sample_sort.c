@@ -1,0 +1,156 @@
+/* sample_sort — distributed splitter-based sort on the comm.h shim.
+ *
+ * Same capability as the reference program (mpi_sample_sort.c:28-218):
+ * block-distribute, local sort, sample, splitters, repartition by
+ * splitter, exchange, local sort, gather to root — with the redesigned
+ * internals this repo's TPU engine uses (mpitest_tpu/models/
+ * sample_sort.py is the same algorithm over XLA collectives):
+ *
+ *   - splitters are computed REPLICATED from an allgather of samples —
+ *     no root protocol, no per-sample Isend with index-as-tag
+ *     (mpi_sample_sort.c:101-132);
+ *   - bucket boundaries come from binary search over the locally sorted
+ *     block (O(P log m)), not an O(P)-per-key linear scan (:148-155);
+ *   - the exchange is a real alltoallv with explicit counts — no fixed
+ *     1.5x bucket cap to overflow under skew (:140-144), no payload
+ *     length smuggled in message tags (:161);
+ *   - P ∤ N is correct (scatterv), negatives are correct (bias encode).
+ *
+ * Output contract is byte-compatible: "Each bucket will be put %u
+ * items." (:74), "The n/2-th sorted element: %d" (:205), stderr
+ * "Endtime()-Starttime() = %.5f sec" (:207).
+ */
+#include "comm.h"
+#include "sort_common.h"
+
+enum { OVERSAMPLE_FACTOR = 2 }; /* samples/rank = 2P-1, like :89 */
+
+typedef struct {
+    sort_args a;
+} prog_state;
+
+static void run(comm_ctx *c, void *vs) {
+    prog_state *st = (prog_state *)vs;
+    const int rank = comm_rank(c), P = comm_size(c);
+    const int debug = st->a.debug;
+
+    /* -- rank 0: read + encode ------------------------------------- */
+    uint32_t *all = NULL;
+    size_t n = 0;
+    double start = 0;
+    if (rank == 0) {
+        size_t nn = 0;
+        int32_t *raw = read_keys_file(st->a.path, &nn);
+        if (!raw || nn == 0) {
+            char msg[512];
+            snprintf(msg, sizeof msg,
+                     "sort(): '%s' is not a valid file for read.", st->a.path);
+            comm_abort(c, 1, msg);
+        }
+        all = (uint32_t *)malloc(nn * sizeof(uint32_t));
+        for (size_t i = 0; i < nn; i++) all[i] = key_encode(raw[i]);
+        free(raw);
+        n = nn;
+        if (debug > 1) printf("[MASTER] Read file: %s (%zu keys)\n", st->a.path, n);
+        start = comm_wtime();
+    }
+    uint64_t n64 = (uint64_t)n;
+    comm_bcast(c, &n64, sizeof n64, 0);
+    n = (size_t)n64;
+    if (rank == 0) printf("Each bucket will be put %zu items.\n", (n + (size_t)P - 1) / (size_t)P);
+
+    /* -- block distribution (scatterv: correct for P ∤ N) ----------- */
+    size_t m = block_count(n, P, rank);
+    uint32_t *mine = (uint32_t *)malloc((m ? m : 1) * sizeof(uint32_t));
+    size_t *counts = (size_t *)malloc((size_t)P * sizeof(size_t));
+    size_t *displs = (size_t *)malloc((size_t)P * sizeof(size_t));
+    for (int i = 0; i < P; i++) {
+        counts[i] = block_count(n, P, i) * sizeof(uint32_t);
+        displs[i] = block_start(n, P, i) * sizeof(uint32_t);
+    }
+    comm_scatterv(c, all, counts, displs, mine, m * sizeof(uint32_t), 0);
+
+    /* -- local sort + evenly spaced samples ------------------------- */
+    qsort(mine, m, sizeof(uint32_t), cmp_u32);
+    if (debug) printf("[COMMON] %d: local sort of %zu keys OK\n", rank, m);
+
+    const size_t S = (size_t)OVERSAMPLE_FACTOR * (size_t)P - 1; /* 2P-1, like :89 */
+    uint32_t *samples = (uint32_t *)malloc(S * sizeof(uint32_t));
+    for (size_t i = 0; i < S; i++) {
+        /* spread over [0, m) inclusive of both ends; UINT32_MAX pads an
+         * empty block (no "no enough sample" abort path, :96-99) */
+        samples[i] = m ? mine[i * (m - 1) / (S > 1 ? S - 1 : 1)] : UINT32_MAX;
+    }
+
+    /* -- replicated splitters from an allgather --------------------- */
+    uint32_t *all_samples = (uint32_t *)malloc((size_t)P * (size_t)S * sizeof(uint32_t));
+    comm_allgather(c, samples, all_samples, (size_t)S * sizeof(uint32_t));
+    qsort(all_samples, (size_t)P * (size_t)S, sizeof(uint32_t), cmp_u32);
+    uint32_t *splitters = (uint32_t *)malloc((size_t)(P - 1) * sizeof(uint32_t));
+    for (int i = 1; i < P; i++)
+        splitters[i - 1] = all_samples[(size_t)i * (size_t)S];
+    if (debug > 1 && rank == 0)
+        for (int i = 0; i < P - 1; i++)
+            printf("[MASTER] Splitter: %u.\n", splitters[i]);
+
+    /* -- bucket boundaries by binary search over the sorted block --- */
+    size_t *scounts = (size_t *)calloc((size_t)P, sizeof(size_t));
+    size_t *sdispls = (size_t *)calloc((size_t)P, sizeof(size_t));
+    size_t prev = 0;
+    for (int p = 0; p < P; p++) {
+        size_t hi = m;
+        if (p < P - 1) { /* upper_bound(splitters[p]): keys <= splitter go left, like :149 */
+            size_t lo = prev;
+            hi = m;
+            while (lo < hi) {
+                size_t mid = lo + (hi - lo) / 2;
+                if (mine[mid] <= splitters[p]) lo = mid + 1; else hi = mid;
+            }
+            hi = lo;
+        }
+        sdispls[p] = prev * sizeof(uint32_t);
+        scounts[p] = (hi - prev) * sizeof(uint32_t);
+        prev = hi;
+    }
+
+    /* -- exchange: counts as data, then alltoallv ------------------- */
+    size_t *rcounts = (size_t *)malloc((size_t)P * sizeof(size_t));
+    comm_alltoall(c, scounts, rcounts, sizeof(size_t));
+    size_t *rdispls = (size_t *)malloc((size_t)P * sizeof(size_t));
+    size_t total = 0;
+    for (int p = 0; p < P; p++) { rdispls[p] = total; total += rcounts[p]; }
+    uint32_t *bucket = (uint32_t *)malloc((total ? total : 1));
+    comm_alltoallv(c, mine, scounts, sdispls, bucket, rcounts, rdispls);
+    size_t bn = total / sizeof(uint32_t);
+    if (debug) printf("[COMMON] %d: exchange OK, bucket=%zu keys\n", rank, bn);
+
+    /* -- final local sort + gather to root -------------------------- */
+    qsort(bucket, bn, sizeof(uint32_t), cmp_u32);
+
+    size_t my_bytes = bn * sizeof(uint32_t);
+    size_t *gcounts = (size_t *)malloc((size_t)P * sizeof(size_t));
+    comm_gather(c, &my_bytes, gcounts, sizeof(size_t), 0);
+    size_t *gdispls = NULL;
+    if (rank == 0) { /* exclusive prefix sum — the :188-192 displacement step */
+        gdispls = (size_t *)malloc((size_t)P * sizeof(size_t));
+        size_t acc = 0;
+        for (int p = 0; p < P; p++) { gdispls[p] = acc; acc += gcounts[p]; }
+    }
+    comm_gatherv(c, bucket, my_bytes, all, gcounts, gdispls, 0);
+
+    if (rank == 0) {
+        double end = comm_wtime();
+        print_result(all, n, end - start, debug);
+        free(all);
+        free(gdispls);
+    }
+    free(mine); free(counts); free(displs); free(samples); free(all_samples);
+    free(splitters); free(scounts); free(sdispls); free(rcounts);
+    free(rdispls); free(bucket); free(gcounts);
+}
+
+int main(int argc, char **argv) {
+    prog_state st = {{NULL, 0}};
+    if (parse_args(argc, argv, &st.a) != 0) return EXIT_FAILURE;
+    return comm_launch(run, &st);
+}

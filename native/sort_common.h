@@ -1,0 +1,106 @@
+/* sort_common.h — pieces shared by the two native sort drivers.
+ *
+ * Reproduces the reference's I/O and CLI behavior minus its bugs
+ * (SURVEY.md §7.4): the reader counts exactly the integers present (no
+ * feof overcount, mpi_sample_sort.c:50), grows geometrically instead of
+ * one int per realloc (:53), and keys are bias-encoded to uint32 so
+ * negative keys order correctly (the reference sorts by |x|,
+ * mpi_radix_sort.c:50,56).
+ */
+#ifndef SORT_COMMON_H
+#define SORT_COMMON_H
+
+#include <inttypes.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "comm.h"
+
+/* Order-preserving encode: int32 -> uint32 (flip sign bit). */
+static inline uint32_t key_encode(int32_t v) {
+    return (uint32_t)v ^ 0x80000000u;
+}
+static inline int32_t key_decode(uint32_t u) {
+    return (int32_t)(u ^ 0x80000000u);
+}
+
+/* Read all whitespace-separated decimal int32s; exact count, geometric
+ * growth.  Returns NULL (with *out_n untouched) on open failure. */
+static inline int32_t *read_keys_file(const char *path, size_t *out_n) {
+    FILE *f = fopen(path, "r");
+    if (!f) return NULL;
+    size_t cap = 1024, n = 0;
+    int32_t *buf = (int32_t *)malloc(cap * sizeof(int32_t));
+    if (!buf) { fclose(f); return NULL; }
+    long long v;
+    while (fscanf(f, "%lld", &v) == 1) {
+        if (n == cap) {
+            cap *= 2;
+            int32_t *nb = (int32_t *)realloc(buf, cap * sizeof(int32_t));
+            if (!nb) { free(buf); fclose(f); return NULL; }
+            buf = nb;
+        }
+        buf[n++] = (int32_t)v;
+    }
+    fclose(f);
+    *out_n = n;
+    return buf;
+}
+
+/* Block distribution: rank i owns n/P + (i < n%P) keys — every rank's
+ * buffer matches what it receives (the reference ships ceil(N/P) to a
+ * smaller last-rank buffer whenever P does not divide N,
+ * mpi_sample_sort.c:80-82). */
+static inline size_t block_count(size_t n, int nranks, int rank) {
+    size_t q = n / (size_t)nranks, r = n % (size_t)nranks;
+    return q + ((size_t)rank < r ? 1 : 0);
+}
+static inline size_t block_start(size_t n, int nranks, int rank) {
+    size_t q = n / (size_t)nranks, r = n % (size_t)nranks;
+    size_t rr = (size_t)rank < r ? (size_t)rank : r;
+    return q * (size_t)rank + rr;
+}
+/* Owner of global position `pos` under the same distribution. */
+static inline int block_owner(size_t n, int nranks, size_t pos) {
+    size_t q = n / (size_t)nranks, r = n % (size_t)nranks;
+    if (q == 0) return (int)pos; /* n < P: one key per low rank */
+    if (pos < (q + 1) * r) return (int)(pos / (q + 1));
+    return (int)(r + (pos - (q + 1) * r) / q);
+}
+
+static inline int cmp_u32(const void *a, const void *b) {
+    uint32_t x = *(const uint32_t *)a, y = *(const uint32_t *)b;
+    return x < y ? -1 : (x > y ? 1 : 0);
+}
+
+/* The reference's machine interface (SURVEY.md §5 metrics row):
+ * stdout median probe + optional full dump, stderr elapsed seconds. */
+static inline void print_result(const uint32_t *sorted, size_t n,
+                                double elapsed, int debug) {
+    if (debug > 2) {
+        for (size_t i = 0; i < n; i++)
+            printf("%zu|%u\n", i, (uint32_t)key_decode(sorted[i]));
+    }
+    size_t mid = n >= 2 ? n / 2 - 1 : 0;
+    printf("The n/2-th sorted element: %d\n", key_decode(sorted[mid]));
+    fprintf(stderr, "Endtime()-Starttime() = %.5f sec\n", elapsed);
+}
+
+/* argv contract shared by both drivers (mpi_sample_sort.c:230-237). */
+typedef struct {
+    const char *path;
+    int debug;
+} sort_args;
+
+static inline int parse_args(int argc, char **argv, sort_args *out) {
+    if (argc != 2 && argc != 3) {
+        fprintf(stderr, "Usage: %s <file: Data file to read>\n", argv[0]);
+        return -1;
+    }
+    out->path = argv[1];
+    out->debug = argc == 3 ? atoi(argv[2]) : 0;
+    return 0;
+}
+
+#endif /* SORT_COMMON_H */

@@ -1,0 +1,68 @@
+"""CLI contract tests: the reference's argv/stdout/stderr interface.
+
+Run in-process (importing drivers/sort_cli) against the virtual CPU mesh —
+a subprocess per case would pay the full JAX startup each time.
+"""
+
+import importlib.util
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "drivers"))
+
+spec = importlib.util.spec_from_file_location(
+    "sort_cli", os.path.join(os.path.dirname(__file__), "..", "drivers", "sort_cli.py")
+)
+sort_cli = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(sort_cli)
+
+
+@pytest.fixture
+def keyfile(tmp_path, rng):
+    keys = rng.integers(-(2**31), 2**31 - 1, size=1000, dtype=np.int32)
+    p = tmp_path / "keys.txt"
+    p.write_text("\n".join(str(k) for k in keys) + "\n")
+    return str(p), keys
+
+
+def test_usage_error(capsys):
+    assert sort_cli.main(["sort_cli.py"]) != 0
+    assert "Usage:" in capsys.readouterr().err
+
+
+def test_bad_file(capsys):
+    assert sort_cli.main(["sort_cli.py", "/nonexistent/file.txt"]) != 0
+    err = capsys.readouterr().err
+    assert "is not a valid file for read." in err
+
+
+@pytest.mark.parametrize("algo", ["sample", "radix"])
+def test_output_contract(algo, keyfile, capsys, monkeypatch):
+    path, keys = keyfile
+    monkeypatch.setenv("SORT_ALGO", algo)
+    assert sort_cli.main(["sort_cli.py", path]) == 0
+    out = capsys.readouterr()
+    ref = np.sort(keys)
+    lines = out.out.strip().splitlines()
+    if algo == "sample":
+        assert lines[0] == f"Each bucket will be put {-(-1000 // 8)} items."
+    assert lines[-1] == f"The n/2-th sorted element: {ref[499]}"
+    assert "Endtime()-Starttime() = " in out.err
+    assert out.err.strip().endswith("sec")
+
+
+def test_debug_dump_sorted(keyfile, capsys, monkeypatch):
+    path, keys = keyfile
+    monkeypatch.setenv("SORT_ALGO", "radix")
+    assert sort_cli.main(["sort_cli.py", path, "3"]) == 0
+    out = capsys.readouterr().out
+    dump = [
+        int(line.split("|")[1])
+        for line in out.splitlines()
+        if "|" in line and not line.startswith("[")
+    ]
+    expect = [int(v) & 0xFFFFFFFF for v in np.sort(keys)]
+    assert dump == expect

@@ -1,0 +1,133 @@
+"""Native (C, comm.h shim) backend tests: build, run, golden parity.
+
+SURVEY.md §4: golden-output parity between backends on identical input
+files, multi-"rank" simulation without a cluster (local backend = P
+pthread ranks via COMM_RANKS), skew and non-divisible-N cases the
+reference gets wrong.
+"""
+
+import shutil
+import subprocess
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(scope="module")
+def binaries():
+    if shutil.which("cc") is None and shutil.which("gcc") is None:
+        pytest.skip("no C compiler")
+    for d in ("mpi_sample_sort", "mpi_radix_sort"):
+        r = subprocess.run(
+            ["make", "-C", str(REPO / d), "BACKEND=local"],
+            capture_output=True, text=True,
+        )
+        assert r.returncode == 0, r.stderr
+    return {
+        "sample": str(REPO / "mpi_sample_sort" / "sample_sort"),
+        "radix": str(REPO / "mpi_radix_sort" / "radix_sort"),
+    }
+
+
+def run_native(binary, path, ranks=4, debug=0, env=None):
+    import os
+
+    full_env = dict(os.environ, COMM_RANKS=str(ranks), **(env or {}))
+    return subprocess.run(
+        [binary, str(path)] + ([str(debug)] if debug else []),
+        capture_output=True, text=True, env=full_env, timeout=120,
+    )
+
+
+def write_keys(tmp_path, keys):
+    p = tmp_path / "keys.txt"
+    p.write_text("\n".join(str(k) for k in keys) + "\n")
+    return p
+
+
+@pytest.mark.parametrize("algo", ["sample", "radix"])
+@pytest.mark.parametrize("n,ranks", [(1000, 4), (1003, 7), (64, 8), (5, 8)])
+def test_native_median_contract(algo, n, ranks, binaries, tmp_path, rng):
+    keys = rng.integers(-(2**31), 2**31 - 1, size=n, dtype=np.int32)
+    p = write_keys(tmp_path, keys)
+    r = run_native(binaries[algo], p, ranks=ranks)
+    assert r.returncode == 0, r.stderr
+    ref = np.sort(keys)
+    assert f"The n/2-th sorted element: {ref[max(n // 2 - 1, 0)]}" in r.stdout
+    assert "Endtime()-Starttime() = " in r.stderr
+
+
+@pytest.mark.parametrize("algo", ["sample", "radix"])
+def test_native_full_output_sorted(algo, binaries, tmp_path, rng):
+    """debug>2 dump = the complete sorted array, bit-identical to np.sort."""
+    keys = rng.integers(-(2**31), 2**31 - 1, size=777, dtype=np.int32)
+    p = write_keys(tmp_path, keys)
+    r = run_native(binaries[algo], p, ranks=4, debug=3)
+    assert r.returncode == 0, r.stderr
+    dump = [
+        np.uint32(line.split("|")[1]) for line in r.stdout.splitlines()
+        if "|" in line and not line.startswith("[")
+    ]
+    got = np.array(dump, np.uint32).view(np.int32)
+    np.testing.assert_array_equal(got, np.sort(keys))
+
+
+@pytest.mark.parametrize("algo", ["sample", "radix"])
+def test_native_zipf_skew(algo, binaries, tmp_path):
+    """Skewed duplicates — the reference's silent bucket overflow config
+    (mpi_sample_sort.c:140-144); the shim-based rewrite must be exact."""
+    from mpitest_tpu.utils import io
+
+    keys = np.clip(io.generate_zipf(30_000, seed=5), 0, 2**31 - 1).astype(np.int32)
+    p = write_keys(tmp_path, keys)
+    r = run_native(binaries[algo], p, ranks=8)
+    assert r.returncode == 0, r.stderr
+    ref = np.sort(keys)
+    assert f"The n/2-th sorted element: {ref[15_000 - 1]}" in r.stdout
+
+
+def test_native_radix_bits_knob(binaries, tmp_path, rng):
+    keys = rng.integers(-(2**20), 2**20, size=2000, dtype=np.int32)
+    p = write_keys(tmp_path, keys)
+    for bits in (4, 11, 16):
+        r = run_native(binaries["radix"], p, ranks=4, env={"RADIX_BITS": str(bits)})
+        assert r.returncode == 0, r.stderr
+        assert f"The n/2-th sorted element: {np.sort(keys)[999]}" in r.stdout
+
+
+@pytest.mark.parametrize("algo", ["sample", "radix"])
+def test_native_bad_file_contract(algo, binaries):
+    r = run_native(binaries[algo], "/nonexistent/x.txt")
+    assert r.returncode != 0
+    assert "is not a valid file for read." in r.stderr
+
+
+@pytest.mark.parametrize("algo", ["sample", "radix"])
+def test_native_usage_contract(algo, binaries):
+    r = subprocess.run([binaries[algo]], capture_output=True, text=True)
+    assert r.returncode != 0
+    assert "Usage:" in r.stderr
+
+
+def test_native_vs_tpu_golden_parity(binaries, tmp_path, rng):
+    """The north-star contract: native and TPU backends, same input file,
+    bit-identical sorted output and identical median line."""
+    from mpitest_tpu.models.api import sort as tpu_sort
+    from mpitest_tpu.parallel.mesh import make_mesh
+
+    keys = rng.integers(-(2**31), 2**31 - 1, size=4096, dtype=np.int32)
+    p = write_keys(tmp_path, keys)
+    mesh = make_mesh(8)
+    tpu_out = tpu_sort(keys, algorithm="radix", mesh=mesh)
+
+    for algo in ("sample", "radix"):
+        r = run_native(binaries[algo], p, ranks=8, debug=3)
+        dump = [
+            np.uint32(line.split("|")[1]) for line in r.stdout.splitlines()
+            if "|" in line and not line.startswith("[")
+        ]
+        native_out = np.array(dump, np.uint32).view(np.int32)
+        assert native_out.tobytes() == tpu_out.tobytes()

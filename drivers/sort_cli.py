@@ -27,8 +27,13 @@ from __future__ import annotations
 import os
 import sys
 import time
+from pathlib import Path
 
 import numpy as np
+
+# Script-invocation bootstrap: the repo root (not drivers/) holds the
+# package, and this image cannot `pip install -e .` (see verify skill).
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
 def main(argv: list[str] | None = None) -> int:

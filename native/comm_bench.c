@@ -1,0 +1,73 @@
+/* comm_bench — collective micro-benchmark on the comm.h shim.
+ *
+ * Measures achieved alltoallv bandwidth (the BASELINE.json secondary
+ * metric: "Alltoallv vs lax.all_to_all GB/s" — the Python half lives in
+ * bench/collective_bench.py).  Every rank sends `bytes_per_peer` to every
+ * peer for `reps` rounds; reported bandwidth is aggregate moved bytes /
+ * wall time on rank 0.
+ *
+ * Usage: comm_bench [bytes_per_peer] [reps]     (COMM_RANKS / mpirun -np)
+ * Output (rank 0, stdout): one JSON line
+ *   {"metric": "alltoallv_gb_per_s", "value": V, "unit": "GB/s",
+ *    "ranks": P, "bytes_per_peer": B, "reps": R}
+ */
+#include "comm.h"
+
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+typedef struct {
+    size_t bytes_per_peer;
+    int reps;
+} bench_args;
+
+static void run(comm_ctx *c, void *va) {
+    const bench_args *a = (const bench_args *)va;
+    const int rank = comm_rank(c), P = comm_size(c);
+    const size_t B = a->bytes_per_peer;
+
+    char *send = (char *)malloc((size_t)P * B);
+    char *recv = (char *)malloc((size_t)P * B);
+    size_t *counts = (size_t *)malloc((size_t)P * sizeof(size_t));
+    size_t *displs = (size_t *)malloc((size_t)P * sizeof(size_t));
+    if (!send || !recv || !counts || !displs)
+        comm_abort(c, 1, "comm_bench: allocation failed");
+    memset(send, (char)rank, (size_t)P * B);
+    for (int p = 0; p < P; p++) {
+        counts[p] = B;
+        displs[p] = (size_t)p * B;
+    }
+
+    /* warmup round, then timed reps */
+    comm_alltoallv(c, send, counts, displs, recv, counts, displs);
+    comm_barrier(c);
+    double t0 = comm_wtime();
+    for (int r = 0; r < a->reps; r++)
+        comm_alltoallv(c, send, counts, displs, recv, counts, displs);
+    comm_barrier(c);
+    double dt = comm_wtime() - t0;
+
+    if (rank == 0) {
+        /* bytes crossing between ranks per round: P ranks × (P-1) remote
+         * peers × B (self-destined blocks are local memcpys, excluded) */
+        double moved = (double)P * (double)(P > 1 ? P - 1 : 1) * (double)B
+                       * (double)a->reps;
+        printf("{\"metric\": \"alltoallv_gb_per_s\", \"value\": %.3f, "
+               "\"unit\": \"GB/s\", \"ranks\": %d, \"bytes_per_peer\": %zu, "
+               "\"reps\": %d}\n",
+               moved / dt / 1e9, P, B, a->reps);
+    }
+    free(send); free(recv); free(counts); free(displs);
+}
+
+int main(int argc, char **argv) {
+    bench_args a;
+    a.bytes_per_peer = argc > 1 ? (size_t)atoll(argv[1]) : (size_t)1 << 22;
+    a.reps = argc > 2 ? atoi(argv[2]) : 20;
+    if (a.bytes_per_peer == 0 || a.reps <= 0) {
+        fprintf(stderr, "Usage: %s [bytes_per_peer] [reps]\n", argv[0]);
+        return EXIT_FAILURE;
+    }
+    return comm_launch(run, &a);
+}

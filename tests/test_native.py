@@ -112,6 +112,27 @@ def test_native_usage_contract(algo, binaries):
     assert "Usage:" in r.stderr
 
 
+def test_comm_bench_microbenchmark(binaries, tmp_path):
+    """The alltoallv half of BASELINE.md row 7 emits one valid JSON line."""
+    import json
+    import os
+
+    r = subprocess.run(
+        ["make", "-C", str(REPO / "bench"), "BACKEND=local"],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stderr
+    r = subprocess.run(
+        [str(REPO / "bench" / "comm_bench"), "65536", "3"],
+        capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, COMM_RANKS="4"),
+    )
+    assert r.returncode == 0, r.stderr
+    obj = json.loads(r.stdout.strip())
+    assert obj["metric"] == "alltoallv_gb_per_s"
+    assert obj["ranks"] == 4 and obj["value"] > 0
+
+
 def test_native_vs_tpu_golden_parity(binaries, tmp_path, rng):
     """The north-star contract: native and TPU backends, same input file,
     bit-identical sorted output and identical median line."""

@@ -72,7 +72,10 @@ def main() -> None:
     int(jax.device_get(out[-1, -1]))  # sync (block_until_ready is advisory here)
     dt = time.perf_counter() - t0
 
-    moved = float(n_ranks * n_ranks * lanes * 4) * args.reps
+    # inter-rank bytes only, matching native/comm_bench.c (self-destined
+    # blocks never cross the fabric)
+    remote_peers = n_ranks - 1 if n_ranks > 1 else 1
+    moved = float(n_ranks * remote_peers * lanes * 4) * args.reps
     m = Metrics(config={
         "ranks": n_ranks, "bytes_per_peer": args.bytes_per_peer,
         "reps": args.reps, "platform": jax.devices()[0].platform,

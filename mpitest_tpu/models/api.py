@@ -93,9 +93,29 @@ class DistributedSortResult:
         )
 
 
-def _round_cap(c: int) -> int:
-    """Round caps up to a lane-friendly multiple (TPU minor dim = 128)."""
-    return max(128, ((c + 127) // 128) * 128)
+def _round_cap(c: int, align: int = 128) -> int:
+    """Round caps up to a lane-friendly multiple: 128 (TPU minor dim) for
+    the XLA pack, 1024 (the DMA chunk) for the Pallas pack."""
+    return max(align, ((c + align - 1) // align) * align)
+
+
+_PACK_IMPLS = ("xla", "pallas", "pallas_interpret")
+
+
+def _resolve_pack(pack: str | None) -> str:
+    """Exchange-pack implementation: Pallas DMA pack on real TPU (4.7×
+    the XLA scatter spread at 2^26 on v5e), XLA elsewhere."""
+    if pack is None:
+        return "pallas" if jax.default_backend() == "tpu" else "xla"
+    if pack not in _PACK_IMPLS:
+        raise ValueError(f"unknown pack {pack!r}; use one of {_PACK_IMPLS}")
+    return pack
+
+
+def _cap_align(pack: str) -> int:
+    from mpitest_tpu.ops.pallas_kernels import CHUNK
+
+    return CHUNK if pack.startswith("pallas") else 128
 
 
 def _needed_passes(words: tuple[np.ndarray, ...], digit_bits: int) -> int:
@@ -192,12 +212,12 @@ def _compile_local(n_words: int):
 
 @lru_cache(maxsize=64)
 def _compile_radix(mesh: Mesh, n_words: int, n: int, digit_bits: int, cap: int,
-                   passes: int):
+                   passes: int, pack: str):
     n_ranks = mesh.devices.size
 
     def f(*words):
         out, max_cnt = radix_sort.radix_sort_spmd(
-            words, n_words, digit_bits, n_ranks, cap, passes
+            words, n_words, digit_bits, n_ranks, cap, passes, pack=pack
         )
         return out, max_cnt
 
@@ -207,17 +227,21 @@ def _compile_radix(mesh: Mesh, n_words: int, n: int, digit_bits: int, cap: int,
             mesh=mesh,
             in_specs=(P(AXIS),) * n_words,
             out_specs=((P(AXIS),) * n_words, P()),
+            # pallas_call's internal ops mix varying/unvarying operands in
+            # ways the vma checker rejects; out_specs are explicit here.
+            check_vma=(pack == "xla"),
         )
     )
 
 
 @lru_cache(maxsize=64)
-def _compile_sample(mesh: Mesh, n_words: int, n: int, cap: int, oversample: int):
+def _compile_sample(mesh: Mesh, n_words: int, n: int, cap: int, oversample: int,
+                    pack: str):
     n_ranks = mesh.devices.size
 
     def f(*words):
         out, count, max_cnt = sample_sort.sample_sort_spmd(
-            words, n_words, n_ranks, cap, oversample
+            words, n_words, n_ranks, cap, oversample, pack=pack
         )
         return out, count[None], max_cnt
 
@@ -227,6 +251,7 @@ def _compile_sample(mesh: Mesh, n_words: int, n: int, cap: int, oversample: int)
             mesh=mesh,
             in_specs=(P(AXIS),) * n_words,
             out_specs=((P(AXIS),) * n_words, P(AXIS), P()),
+            check_vma=(pack == "xla"),
         )
     )
 
@@ -251,6 +276,7 @@ def sort(
     oversample: int | None = None,
     tracer: Tracer | None = None,
     return_result: bool = False,
+    pack: str | None = None,   # exchange pack impl; None = auto by backend
 ):
     """Sort integer keys on the mesh; returns a sorted numpy array
     (or the device-resident :class:`DistributedSortResult`).
@@ -328,6 +354,10 @@ def sort(
         with tracer.phase("device_put"):
             words = _shard_input(words_np, mesh, n, pad)
 
+    pack_impl = _resolve_pack(pack)
+    align = _cap_align(pack_impl)
+    cap = _round_cap(int(n / n_ranks * cap_factor) + 1, align)
+
     if algorithm == "radix":
         with tracer.phase("plan"):
             if words_np is None:
@@ -339,31 +369,31 @@ def sort(
                 passes = min(math.ceil(diff.bit_length() / digit_bits), per_word)
             else:
                 passes = _needed_passes(words_np, digit_bits)
-        cap = _round_cap(int(n / n_ranks * cap_factor) + 1)
         while True:
-            fn = _compile_radix(mesh, codec.n_words, n, digit_bits, cap, passes)
+            fn = _compile_radix(mesh, codec.n_words, n, digit_bits, cap, passes,
+                                pack_impl)
             with tracer.phase("sort"):
                 out, max_cnt = fn(*words)
                 max_cnt = int(max_cnt)
             if max_cnt <= cap:
                 break
             tracer.verbose(f"radix exchange overflow (need {max_cnt} > cap {cap}); retrying")
-            cap = _round_cap(max_cnt)
+            cap = _round_cap(max_cnt, align)
         res = DistributedSortResult(out, N, dtype)
     elif algorithm == "sample":
         if oversample is None:
             oversample = max(2 * n_ranks - 1, 8)
         oversample = min(oversample, n)
-        cap = _round_cap(int(n / n_ranks * cap_factor) + 1)
         while True:
-            fn = _compile_sample(mesh, codec.n_words, n, cap, oversample)
+            fn = _compile_sample(mesh, codec.n_words, n, cap, oversample,
+                                 pack_impl)
             with tracer.phase("sort"):
                 out, counts, max_cnt = fn(*words)
                 max_cnt = int(max_cnt)
             if max_cnt <= cap:
                 break
             tracer.verbose(f"sample exchange overflow (need {max_cnt} > cap {cap}); retrying")
-            cap = _round_cap(max_cnt)
+            cap = _round_cap(max_cnt, align)
         counts = np.asarray(counts)
         res = DistributedSortResult(
             out, N, dtype, counts=counts, shard_slots=n_ranks * cap

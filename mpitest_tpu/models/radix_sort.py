@@ -55,7 +55,8 @@ Words = tuple[jax.Array, ...]
 
 
 def _one_pass(words: Words, word_idx: int, shift: int, digit_bits: int,
-              n_ranks: int, cap: int, axis: str) -> tuple[Words, jax.Array]:
+              n_ranks: int, cap: int, axis: str,
+              pack: str = "xla") -> tuple[Words, jax.Array]:
     """One LSD pass, built only from TPU-fast primitives: fused multi-
     operand ``lax.sort``, ``searchsorted`` over sorted data, cumsum, and
     K-element scatters (K = bins or ranks).  Per-element gathers/scatters
@@ -90,7 +91,7 @@ def _one_pass(words: Words, word_idx: int, shift: int, digit_bits: int,
     # Keys only on the wire — the receiver recomputes digits from the key
     # words, so no index payload rides the exchange.
     recv, recv_cnt, max_cnt = coll.ragged_all_to_all(
-        sorted_words, send_start, send_cnt, cap, n_ranks, axis
+        sorted_words, send_start, send_cnt, cap, n_ranks, axis, pack=pack
     )
 
     # Receiver-side placement is a P-way merge by (digit, sender, arrival):
@@ -119,6 +120,7 @@ def radix_sort_spmd(
     cap: int,
     passes: int | None = None,
     axis: str = AXIS,
+    pack: str = "xla",
 ) -> tuple[Words, jax.Array]:
     """Full multi-pass radix sort of the shard. SPMD; call under shard_map.
 
@@ -142,7 +144,8 @@ def radix_sort_spmd(
             if done >= total:
                 break
             words, mc = _one_pass(
-                words, w_idx, p * digit_bits, digit_bits, n_ranks, cap, axis
+                words, w_idx, p * digit_bits, digit_bits, n_ranks, cap, axis,
+                pack=pack,
             )
             max_cnt = jnp.maximum(max_cnt, mc)
             done += 1

@@ -65,6 +65,7 @@ def sample_sort_spmd(
     cap: int,
     oversample: int,
     axis: str = AXIS,
+    pack: str = "xla",
 ) -> tuple[Words, jax.Array, jax.Array]:
     """Full sample sort of the shard. SPMD; call under shard_map.
 
@@ -86,7 +87,7 @@ def sample_sort_spmd(
     sentinel = (keys.MAX_WORD,) * n_words
     recv, recv_cnt, max_cnt = coll.ragged_all_to_all(
         sorted_words, send_start, send_cnt, cap, n_ranks, axis,
-        fill=sentinel,
+        fill=sentinel, pack=pack,
     )
     # Invalid lanes are max-sentinel filled → they sort to the tail; the
     # first `count` slots after sorting are exactly the valid multiset

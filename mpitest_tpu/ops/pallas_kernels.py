@@ -1,0 +1,117 @@
+"""Pallas TPU kernels — escalation for ops XLA lowers poorly.
+
+One hot op qualifies today: the exchange *pack* — spreading contiguous
+ragged segments into the fixed ``[P, cap]`` all-to-all send matrix.  XLA
+expresses it as an n-element scatter (or row gather), which lowers to
+per-element updates (measured 10-40× a fused sort at 2^26 on v5e,
+`.claude/skills/verify/SKILL.md`).  The Pallas version moves whole chunks:
+per output chunk, one aligned 2-chunk DMA from HBM plus a vectorized
+misaligned-copy shift (two row rolls + a lane roll + select) — no
+per-element addressing anywhere.
+
+Mosaic constraints that shaped the kernel (discovered on hardware):
+sliced-DMA shapes and tile indices must honor the (8, 128) int32 tiling —
+hence the row-aligned loads and the in-register shift instead of an
+arbitrary-offset DMA; 1-D vector ops are unsupported — hence everything
+is [rows, 128].
+
+The local sorts stay on ``lax.sort`` deliberately: XLA's fused sorting
+network is already near memory-bound for 32-bit keys, and a Pallas radix
+sort would need cross-tile scatters — the exact primitive the hardware
+lacks.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128
+ROWS = 8                    # (8, 128) = one int32 tile
+CHUNK = ROWS * LANES        # 1024 elements = 4 KiB per DMA
+
+
+def _pack_kernel(n: int, fill: int, starts_ref, cnts_ref, data_ref,
+                 out_ref, scratch, sem):
+    """Grid (P, cap//CHUNK): instance (p, i) produces out chunk i of
+    destination p: data[starts[p] + i*CHUNK ...][:CHUNK] where in-segment,
+    the fill word beyond ``cnts[p]``."""
+    p = pl.program_id(0)
+    i = pl.program_id(1)
+    start = starts_ref[p]
+    cnt = cnts_ref[p]
+    # Clamp so beyond-count chunks never DMA past the padded data buffer.
+    base = jnp.minimum(start + i * CHUNK, n)
+    arow = pl.multiple_of(((base // LANES) // ROWS) * ROWS, ROWS)
+
+    dma = pltpu.make_async_copy(
+        data_ref.at[pl.ds(arow, 2 * ROWS), :], scratch, sem
+    )
+    dma.start()
+    dma.wait()
+
+    # Misaligned copy in-register: shift the 2-chunk window left by
+    # (base - arow*LANES) elements = r rows + l lanes.
+    sh = base - arow * LANES
+    r, l = sh // LANES, sh % LANES
+    x = scratch[...]                              # [2*ROWS, LANES]
+    a = pltpu.roll(x, -r, 0)
+    b = pltpu.roll(x, -(r + 1), 0)
+    la = pltpu.roll(a, -l, 1)
+    lb = pltpu.roll(b, -l, 1)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (2 * ROWS, LANES), 1)
+    y = jnp.where(lane < LANES - l, la, lb)[:ROWS, :]
+
+    elem = (jax.lax.broadcasted_iota(jnp.int32, (ROWS, LANES), 0) * LANES
+            + jax.lax.broadcasted_iota(jnp.int32, (ROWS, LANES), 1))
+    valid = elem < (cnt - i * CHUNK)
+    out_ref[0, 0] = jnp.where(valid, y, jnp.uint32(fill))
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cap", "n_ranks", "fill", "interpret", "vma")
+)
+def segment_pack(
+    data: jax.Array,     # uint32[n] — segment p is data[starts[p]:+cnts[p]]
+    starts: jax.Array,   # int32[P], ascending, starts[0] == 0
+    cnts: jax.Array,     # int32[P]
+    cap: int,            # static row capacity, multiple of CHUNK
+    n_ranks: int,
+    fill: int = 0,
+    interpret: bool = False,
+    vma: tuple[str, ...] = (),  # mesh axes the output varies over (shard_map)
+) -> jax.Array:          # uint32[P, cap]
+    """Spread ragged contiguous segments into the padded send matrix."""
+    assert cap % CHUNK == 0, cap
+    n = data.shape[0]
+    pad = (-n) % LANES + 2 * CHUNK   # row-shape the data + DMA headroom
+    data_2d = jnp.concatenate(
+        [data, jnp.zeros((pad,), data.dtype)]
+    ).reshape(-1, LANES)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(n_ranks, cap // CHUNK),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(
+            (1, 1, ROWS, LANES), lambda p, i, *_: (p, i, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2 * ROWS, LANES), jnp.uint32),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_pack_kernel, n, fill),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(
+            (n_ranks, cap // CHUNK, ROWS, LANES), data.dtype,
+            vma=frozenset(vma),
+        ),
+        interpret=interpret,
+    )(starts.astype(jnp.int32), cnts.astype(jnp.int32), data_2d)
+    return out.reshape(n_ranks, cap)

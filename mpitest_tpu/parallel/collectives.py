@@ -78,6 +78,7 @@ def ragged_all_to_all(
     n_ranks: int,           # static mesh axis size
     axis: str = AXIS,
     fill: tuple[int, ...] | None = None,  # per-array fill word for invalid lanes
+    pack: str = "xla",      # "xla" | "pallas" | "pallas_interpret"
 ) -> tuple[Words, jax.Array, jax.Array]:
     """``MPI_Alltoallv`` for contiguous ragged segments, on static shapes.
 
@@ -101,14 +102,15 @@ def ragged_all_to_all(
     from mpitest_tpu.ops import kernels
 
     n = arrays[0].shape[0]
-    j = lax.iota(jnp.int32, n)
-    # Destination rank and segment start per element, gather-free: two
-    # P-element scatters + cumsums (per-element gathers from even tiny
-    # tables are ~10× a full sort's cost on v5e; see kernels.piecewise_fill).
-    p_j = kernels.piecewise_fill(send_start, lax.iota(jnp.int32, n_ranks), n)
-    s_j = kernels.piecewise_fill(send_start, send_start, n)
-    c_j = j - s_j                                     # offset within segment
-    slot = jnp.where(c_j < cap, p_j * cap + c_j, n_ranks * cap)  # overflow→drop
+    if pack == "xla":
+        j = lax.iota(jnp.int32, n)
+        # Destination rank and segment start per element, gather-free: two
+        # P-element scatters + cumsums (per-element gathers from even tiny
+        # tables are ~10× a full sort's cost on v5e; kernels.piecewise_fill).
+        p_j = kernels.piecewise_fill(send_start, lax.iota(jnp.int32, n_ranks), n)
+        s_j = kernels.piecewise_fill(send_start, send_start, n)
+        c_j = j - s_j                                 # offset within segment
+        slot = jnp.where(c_j < cap, p_j * cap + c_j, n_ranks * cap)
 
     # Explicit count exchange (replaces tag-as-length, mpi_sample_sort.c:161,168).
     recv_cnt = lax.all_to_all(jnp.minimum(send_cnt, cap), axis, 0, 0, tiled=True)
@@ -116,11 +118,21 @@ def ragged_all_to_all(
     recv_arrays = []
     for k, a in enumerate(arrays):
         fillv = 0 if fill is None else fill[k]
-        send = (
-            jnp.full((n_ranks * cap,), fillv, a.dtype)
-            .at[slot].set(a, mode="drop")
-            .reshape(n_ranks, cap)
-        )
+        if pack == "xla":
+            send = (
+                jnp.full((n_ranks * cap,), fillv, a.dtype)
+                .at[slot].set(a, mode="drop")
+                .reshape(n_ranks, cap)
+            )
+        else:
+            # Pallas DMA pack: whole-chunk copies, no per-element scatter
+            # (4.7× the XLA spread at 2^26 on v5e; ops/pallas_kernels.py).
+            from mpitest_tpu.ops.pallas_kernels import segment_pack
+
+            send = segment_pack(
+                a, send_start, send_cnt, cap, n_ranks, fill=fillv,
+                interpret=(pack == "pallas_interpret"), vma=(axis,),
+            )
         recv = lax.all_to_all(send, axis, 0, 0, tiled=True)
         recv_arrays.append(recv)
 

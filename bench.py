@@ -79,8 +79,8 @@ def main() -> None:
     log(f"median probe: got {probe} expect {expect} ({'OK' if ok else 'MISMATCH'})")
     if not ok:
         log("CORRECTNESS FAILURE — reporting value 0")
-        print(json.dumps({"metric": f"{algo}_sort_mkeys_per_s", "value": 0.0,
-                          "unit": "Mkeys/s", "vs_baseline": 0.0}))
+        print(json.dumps({"metric": f"{algo}_sort_mkeys_per_s_2e{log2n}_{dtype.name}",
+                          "value": 0.0, "unit": "Mkeys/s", "vs_baseline": 0.0}))
         return
 
     from mpitest_tpu.utils.metrics import Metrics

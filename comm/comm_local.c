@@ -95,7 +95,9 @@ void comm_scatterv(comm_ctx *c, const void *send, const size_t *counts,
     comm_barrier(c);
     const slot_t *rs = &c->w->slots[root];
     size_t n = rs->counts[c->rank];
-    if (n > recv_bytes) n = recv_bytes;
+    if (n > recv_bytes)
+        comm_abort(c, 1, "comm_scatterv: recv buffer smaller than root's "
+                         "published count (truncation would corrupt data)");
     memcpy(recv, (const char *)rs->ptr + rs->displs[c->rank], n);
     comm_barrier(c);
 }
@@ -132,6 +134,61 @@ void comm_allgather(comm_ctx *c, const void *send, void *recv, size_t bytes) {
     comm_barrier(c);
 }
 
+/* -- typed reductions ------------------------------------------------ */
+
+static void reduce_identity(void *acc, size_t count, comm_type t, comm_op op) {
+    size_t esz = (t == COMM_T_U32) ? 4 : 8;
+    if (op == COMM_OP_MIN)
+        memset(acc, 0xFF, count * esz);       /* type-max for unsigned */
+    else
+        memset(acc, 0, count * esz);          /* 0: identity of SUM and
+                                               * of MAX on unsigned */
+}
+
+static void reduce_fold(void *acc, const void *in, size_t count, comm_type t,
+                        comm_op op) {
+    if (t == COMM_T_U32) {
+        uint32_t *a = (uint32_t *)acc;
+        const uint32_t *b = (const uint32_t *)in;
+        for (size_t i = 0; i < count; i++) {
+            if (op == COMM_OP_SUM) a[i] += b[i];
+            else if (op == COMM_OP_MIN) { if (b[i] < a[i]) a[i] = b[i]; }
+            else { if (b[i] > a[i]) a[i] = b[i]; }
+        }
+    } else {
+        uint64_t *a = (uint64_t *)acc;
+        const uint64_t *b = (const uint64_t *)in;
+        for (size_t i = 0; i < count; i++) {
+            if (op == COMM_OP_SUM) a[i] += b[i];
+            else if (op == COMM_OP_MIN) { if (b[i] < a[i]) a[i] = b[i]; }
+            else { if (b[i] > a[i]) a[i] = b[i]; }
+        }
+    }
+}
+
+/* Shared core: fold ranks [0, limit) into recv.  Deterministic rank
+ * order, so float-free integer ops aside, results are identical on every
+ * rank and every run. */
+static void reduce_ranks(comm_ctx *c, const void *send, void *recv,
+                         size_t count, comm_type t, comm_op op, int limit) {
+    my_slot(c)->ptr = send;
+    comm_barrier(c);
+    reduce_identity(recv, count, t, op);
+    for (int s = 0; s < limit; s++)
+        reduce_fold(recv, c->w->slots[s].ptr, count, t, op);
+    comm_barrier(c);
+}
+
+void comm_allreduce(comm_ctx *c, const void *send, void *recv, size_t count,
+                    comm_type t, comm_op op) {
+    reduce_ranks(c, send, recv, count, t, op, c->w->nranks);
+}
+
+void comm_exscan(comm_ctx *c, const void *send, void *recv, size_t count,
+                 comm_type t, comm_op op) {
+    reduce_ranks(c, send, recv, count, t, op, c->rank);
+}
+
 void comm_alltoall(comm_ctx *c, const void *send, void *recv, size_t bytes) {
     my_slot(c)->ptr = send;
     comm_barrier(c);
@@ -153,7 +210,9 @@ void comm_alltoallv(comm_ctx *c, const void *send, const size_t *scounts,
     for (int p = 0; p < c->w->nranks; p++) {
         const slot_t *ps = &c->w->slots[p];
         size_t n = ps->counts[c->rank];
-        if (n > rcounts[p]) n = rcounts[p];
+        if (n > rcounts[p])
+            comm_abort(c, 1, "comm_alltoallv: posted recv count smaller than "
+                             "sender's published count (MPI truncation error)");
         memcpy((char *)recv + rdispls[p],
                (const char *)ps->ptr + ps->displs[c->rank], n);
     }

@@ -118,6 +118,21 @@ def _cap_align(pack: str) -> int:
     return CHUNK if pack.startswith("pallas") else 128
 
 
+def _passes_from_diffs(diffs: tuple[int, ...], digit_bits: int) -> int:
+    """Pass count from per-word ``max ^ min`` diffs (msw first) — the shared
+    core of host- and device-side pass planning (see :func:`_needed_passes`)."""
+    n_words = len(diffs)
+    per_word = (32 + digit_bits - 1) // digit_bits
+    for wi, x in enumerate(diffs):  # msw first
+        if x:
+            full_words_below = n_words - 1 - wi
+            return min(
+                full_words_below * per_word + math.ceil(x.bit_length() / digit_bits),
+                per_word * n_words,
+            )
+    return 0
+
+
 def _needed_passes(words: tuple[np.ndarray, ...], digit_bits: int) -> int:
     """Number of LSD passes actually required: digits above the highest
     globally-differing bit are identical everywhere and can be skipped.
@@ -136,31 +151,23 @@ def _needed_passes(words: tuple[np.ndarray, ...], digit_bits: int) -> int:
     bit-count over the whole key, which would undercount whenever
     ``digit_bits`` does not divide 32.
     """
-    n_words = len(words)
-    per_word = (32 + digit_bits - 1) // digit_bits
     if words[0].size == 0:
         return 0
-    for wi, w in enumerate(words):  # msw first
-        x = int(w.max()) ^ int(w.min())
-        if x:
-            full_words_below = n_words - 1 - wi
-            return min(
-                full_words_below * per_word + math.ceil(x.bit_length() / digit_bits),
-                per_word * n_words,
-            )
-    return 0
+    return _passes_from_diffs(
+        tuple(int(w.max()) ^ int(w.min()) for w in words), digit_bits
+    )
 
 
 @lru_cache(maxsize=8)
 def _compile_word_range(dtype_name: str):
-    """min/max of the encoded word — feeds the pass planner for
-    device-resident input (one tiny reduction + scalar sync instead of
-    abandoning pass skipping)."""
+    """Per-word min/max of the encoded key words (msw first) — feeds the
+    pass planner for device-resident input (one tiny reduction + scalar
+    sync instead of abandoning pass skipping)."""
     codec = codec_for(np.dtype(dtype_name))
 
     def f(x):
-        (w,) = codec.encode_jax(x)
-        return jnp.min(w), jnp.max(w)
+        words = codec.encode_jax(x)
+        return tuple((jnp.min(w), jnp.max(w)) for w in words)
 
     return jax.jit(f)
 
@@ -186,11 +193,19 @@ def _compile_encode_pad(dtype_name: str, total: int, mesh: Mesh | None):
     codec = codec_for(np.dtype(dtype_name))
 
     def f(x):
-        (w,) = codec.encode_jax(x)
-        pad = total - w.shape[0]
+        words = codec.encode_jax(x)
+        pad = total - x.shape[0]
         if pad:
-            w = jnp.concatenate([w, jnp.broadcast_to(jnp.max(w), (pad,))])
-        return w
+            # Pad with the maximum real key in the *native* order (encode
+            # is order-preserving, so its word tuple is lexicographically
+            # max) — never a per-word max, which for multi-word keys could
+            # fabricate a key larger than any real one.
+            mx_words = codec.encode_jax(jnp.max(x)[None])
+            words = tuple(
+                jnp.concatenate([w, jnp.broadcast_to(mw[0], (pad,))])
+                for w, mw in zip(words, mx_words)
+            )
+        return words
 
     if mesh is None:
         return jax.jit(f)
@@ -285,10 +300,12 @@ def sort(
     pass count) or ``"sample"`` (one exchange round; cap-sensitive under
     skew).  Both produce identical bytes — sorted output is canonical.
 
-    ``x`` may be a host array OR a device-resident ``jax.Array`` (1-word
-    dtypes): the device path encodes/pads on-device and never round-trips
-    the keys through the host — the framework's steady-state contract
-    (keys live sharded on the mesh; SURVEY.md §5 long-context row).
+    ``x`` may be a host array OR a device-resident ``jax.Array`` (any
+    supported dtype — 64-bit device arrays exist only under
+    ``jax_enable_x64`` and split into uint32 words on-device): the device
+    path encodes/pads on-device and never round-trips the keys through
+    the host — the framework's steady-state contract (keys live sharded
+    on the mesh; SURVEY.md §5 long-context row).
     """
     tracer = tracer or Tracer()
     is_device = isinstance(x, jax.Array)
@@ -333,12 +350,12 @@ def sort(
                 # sharded there); a committed single-device array would
                 # otherwise conflict with the jit's mesh-wide out_shardings.
                 x_flat = jax.device_put(x_flat, key_sharding(mesh))
-                words = (_compile_encode_pad(dtype.name, N, mesh)(x_flat),)
+                words = _compile_encode_pad(dtype.name, N, mesh)(x_flat)
             else:
                 # Uneven N cannot be mesh-sharded directly; encode+pad
                 # wherever the input lives, then land the even result.
-                w = _compile_encode_pad(dtype.name, n_ranks * n, None)(x_flat)
-                words = (jax.device_put(w, key_sharding(mesh)),)
+                ws = _compile_encode_pad(dtype.name, n_ranks * n, None)(x_flat)
+                words = tuple(jax.device_put(w, key_sharding(mesh)) for w in ws)
     else:
         with tracer.phase("encode"):
             flat = x.reshape(-1)
@@ -361,12 +378,13 @@ def sort(
     if algorithm == "radix":
         with tracer.phase("plan"):
             if words_np is None:
-                # Device-resident input: one scalar min/max sync plans the
-                # pass count (pads replicate the max key — range unchanged).
-                wmin, wmax = _compile_word_range(dtype.name)(x.reshape(-1))
-                diff = int(wmin) ^ int(wmax)
-                per_word = (32 + digit_bits - 1) // digit_bits
-                passes = min(math.ceil(diff.bit_length() / digit_bits), per_word)
+                # Device-resident input: one scalar min/max sync per word
+                # plans the pass count (pads replicate the max key — range
+                # unchanged).
+                ranges = _compile_word_range(dtype.name)(x.reshape(-1))
+                passes = _passes_from_diffs(
+                    tuple(int(lo) ^ int(hi) for lo, hi in ranges), digit_bits
+                )
             else:
                 passes = _needed_passes(words_np, digit_bits)
         while True:

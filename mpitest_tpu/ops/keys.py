@@ -77,9 +77,16 @@ class KeyCodec:
         return u  # uint64
 
     def encode_jax(self, x):
-        """Device-side encode for 1-word dtypes (int32/uint32): bitcast +
-        sign-bias XOR, elementwise — XLA fuses it into the consumer sort.
-        64-bit dtypes need the host path (TPU JAX runs without x64)."""
+        """Device-side encode: bitcast + sign-bias XOR, elementwise — XLA
+        fuses it into the consumer sort.
+
+        64-bit dtypes (which only exist as device arrays under
+        ``jax_enable_x64``) never touch 64-bit arithmetic here:
+        ``bitcast_convert_type`` to uint32 appends a trailing word dim
+        (minor word = least significant on TPU/x86), so the split into
+        (hi, lo) uint32 words is a pure relayout that works with or
+        without x64 — device-resident 64-bit keys stay on the mesh with
+        no host round-trip (the framework's steady-state contract)."""
         import jax.numpy as jnp
         from jax import lax
 
@@ -87,6 +94,17 @@ class KeyCodec:
             return (lax.bitcast_convert_type(x, jnp.uint32) ^ jnp.uint32(0x80000000),)
         if self.dtype == np.dtype(np.uint32):
             return (x,)
+        if self.dtype in (np.dtype(np.int64), np.dtype(np.uint64)):
+            if x.dtype != self.dtype:
+                raise TypeError(
+                    f"device array has dtype {x.dtype}, expected {self.dtype} "
+                    "(64-bit device-resident keys require jax_enable_x64)"
+                )
+            w = lax.bitcast_convert_type(x, jnp.uint32)  # [..., 2], minor=lsw
+            lo, hi = w[..., 0], w[..., 1]
+            if self.dtype == np.dtype(np.int64):
+                hi = hi ^ jnp.uint32(0x80000000)
+            return (hi, lo)
         raise TypeError(f"device-side encode unsupported for {self.dtype}")
 
     def max_sentinel(self) -> tuple[int, ...]:

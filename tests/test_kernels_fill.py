@@ -56,6 +56,22 @@ def test_device_resident_input_multi_device(algo, mesh8, rng):
 
 
 @pytest.mark.parametrize("algo", ["radix", "sample"])
+@pytest.mark.parametrize("dtype", [np.int64, np.uint64])
+def test_device_resident_64bit_input(algo, dtype, mesh8, rng):
+    """Device-resident 64-bit keys use the on-device 2-word codec (no host
+    round-trip) — requires x64 only to *hold* the input array; the sort
+    itself runs entirely on uint32 words."""
+    info = np.iinfo(np.dtype(dtype))
+    x = rng.integers(info.min, info.max, size=8 * 256 + 5, dtype=dtype,
+                     endpoint=True)
+    with jax.enable_x64(True):
+        x_dev = jnp.asarray(x)
+        assert x_dev.dtype == np.dtype(dtype)
+        got = sort(x_dev, algorithm=algo, mesh=mesh8)
+    np.testing.assert_array_equal(got, np.sort(x))
+
+
+@pytest.mark.parametrize("algo", ["radix", "sample"])
 @pytest.mark.parametrize("dtype", [np.int32, np.int64])
 def test_single_device_mesh_fast_path(algo, dtype, rng):
     """1-device mesh: both algorithms specialize to the local fused sort."""

@@ -75,6 +75,25 @@ void comm_gatherv(comm_ctx *c, const void *send, size_t send_bytes,
 /* Every rank gets every rank's `bytes`-sized block, rank-major. */
 void comm_allgather(comm_ctx *c, const void *send, void *recv, size_t bytes);
 
+/* Typed elementwise reductions (MPI_Allreduce / MPI_Exscan).  These are
+ * the two census rows (SURVEY.md §2.3/§5) the byte-oriented collectives
+ * cannot express: a reduction needs element type + operator. */
+typedef enum { COMM_OP_SUM, COMM_OP_MIN, COMM_OP_MAX } comm_op;
+typedef enum { COMM_T_U32, COMM_T_U64 } comm_type;
+
+/* recv[i] = op over all ranks of their send[i]; every rank gets the
+ * result (MPI_Allreduce semantics — strictly more than a rooted Reduce,
+ * matching how the TPU twin's psum/pmax replicate for free). */
+void comm_allreduce(comm_ctx *c, const void *send, void *recv, size_t count,
+                    comm_type t, comm_op op);
+
+/* recv[i] = op over ranks r < my rank of their send[i] — the exclusive
+ * prefix (MPI_Exscan), except rank 0's result is DEFINED here as the
+ * operator identity (0 for SUM/MAX on unsigned, type-max for MIN); MPI
+ * leaves it undefined and every caller then special-cases it. */
+void comm_exscan(comm_ctx *c, const void *send, void *recv, size_t count,
+                 comm_type t, comm_op op);
+
 /* Fixed-size all-to-all: block i of `send` goes to rank i; block s of
  * `recv` came from rank s.  `bytes` per block. */
 void comm_alltoall(comm_ctx *c, const void *send, void *recv, size_t bytes);

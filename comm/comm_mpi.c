@@ -19,6 +19,7 @@
 #include <limits.h>
 #include <stdio.h>
 #include <stdlib.h>
+#include <string.h>
 
 struct comm_ctx {
     int rank, size;
@@ -101,6 +102,33 @@ void comm_allgather(comm_ctx *c, const void *send, void *recv, size_t bytes) {
     (void)c;
     MPI_Allgather((void *)send, chk_int(bytes), MPI_BYTE, recv,
                   chk_int(bytes), MPI_BYTE, MPI_COMM_WORLD);
+}
+
+static MPI_Datatype mpi_type(comm_type t) {
+    return t == COMM_T_U32 ? MPI_UINT32_T : MPI_UINT64_T;
+}
+
+static MPI_Op mpi_op(comm_op op) {
+    return op == COMM_OP_SUM ? MPI_SUM : (op == COMM_OP_MIN ? MPI_MIN : MPI_MAX);
+}
+
+void comm_allreduce(comm_ctx *c, const void *send, void *recv, size_t count,
+                    comm_type t, comm_op op) {
+    (void)c;
+    MPI_Allreduce((void *)send, recv, chk_int(count), mpi_type(t), mpi_op(op),
+                  MPI_COMM_WORLD);
+}
+
+void comm_exscan(comm_ctx *c, const void *send, void *recv, size_t count,
+                 comm_type t, comm_op op) {
+    MPI_Exscan((void *)send, recv, chk_int(count), mpi_type(t), mpi_op(op),
+               MPI_COMM_WORLD);
+    if (c->rank == 0) {
+        /* MPI leaves rank 0's Exscan result undefined; comm.h defines it
+         * as the operator identity. */
+        size_t esz = (t == COMM_T_U32) ? 4 : 8;
+        memset(recv, op == COMM_OP_MIN ? 0xFF : 0, count * esz);
+    }
 }
 
 void comm_alltoall(comm_ctx *c, const void *send, void *recv, size_t bytes) {

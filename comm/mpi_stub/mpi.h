@@ -1,0 +1,68 @@
+/* mpi_stub/mpi.h — prototypes-only stub of the MPI-3.1 subset that
+ * comm_mpi.c uses, vendored so images WITHOUT an MPI installation can
+ * still typecheck the MPI backend (`cc -fsyntax-only -I comm/mpi_stub`).
+ *
+ * This is a signature-rot guard, not a functional MPI: there is no
+ * implementation behind these prototypes, and nothing here may be linked.
+ * Real builds use the system <mpi.h> via mpicc (`make BACKEND=mpi`),
+ * which shadows this header entirely.  Signatures follow MPI 3.1 §5-6
+ * (const-correct send buffers, int counts/displacements).
+ */
+#ifndef COMM_MPI_STUB_H
+#define COMM_MPI_STUB_H
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct mpi_stub_comm *MPI_Comm;
+typedef struct mpi_stub_datatype *MPI_Datatype;
+typedef struct mpi_stub_op *MPI_Op;
+
+extern MPI_Comm MPI_COMM_WORLD;
+extern MPI_Datatype MPI_BYTE, MPI_UINT32_T, MPI_UINT64_T;
+extern MPI_Op MPI_SUM, MPI_MIN, MPI_MAX;
+
+int MPI_Init(int *argc, char ***argv);
+int MPI_Finalize(void);
+int MPI_Comm_rank(MPI_Comm comm, int *rank);
+int MPI_Comm_size(MPI_Comm comm, int *size);
+int MPI_Abort(MPI_Comm comm, int errorcode);
+double MPI_Wtime(void);
+int MPI_Barrier(MPI_Comm comm);
+
+int MPI_Bcast(void *buffer, int count, MPI_Datatype datatype, int root,
+              MPI_Comm comm);
+int MPI_Scatter(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+                void *recvbuf, int recvcount, MPI_Datatype recvtype, int root,
+                MPI_Comm comm);
+int MPI_Scatterv(const void *sendbuf, const int *sendcounts,
+                 const int *displs, MPI_Datatype sendtype, void *recvbuf,
+                 int recvcount, MPI_Datatype recvtype, int root,
+                 MPI_Comm comm);
+int MPI_Gather(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+               void *recvbuf, int recvcount, MPI_Datatype recvtype, int root,
+               MPI_Comm comm);
+int MPI_Gatherv(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+                void *recvbuf, const int *recvcounts, const int *displs,
+                MPI_Datatype recvtype, int root, MPI_Comm comm);
+int MPI_Allgather(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+                  void *recvbuf, int recvcount, MPI_Datatype recvtype,
+                  MPI_Comm comm);
+int MPI_Alltoall(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+                 void *recvbuf, int recvcount, MPI_Datatype recvtype,
+                 MPI_Comm comm);
+int MPI_Alltoallv(const void *sendbuf, const int *sendcounts,
+                  const int *sdispls, MPI_Datatype sendtype, void *recvbuf,
+                  const int *recvcounts, const int *rdispls,
+                  MPI_Datatype recvtype, MPI_Comm comm);
+int MPI_Allreduce(const void *sendbuf, void *recvbuf, int count,
+                  MPI_Datatype datatype, MPI_Op op, MPI_Comm comm);
+int MPI_Exscan(const void *sendbuf, void *recvbuf, int count,
+               MPI_Datatype datatype, MPI_Op op, MPI_Comm comm);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* COMM_MPI_STUB_H */

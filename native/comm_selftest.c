@@ -1,0 +1,153 @@
+/* comm_selftest — correctness harness for the comm.h shim surface.
+ *
+ * Exercises every collective against closed-form expectations on
+ * rank-dependent inputs, across whatever COMM_RANKS the runner sets.
+ * This is the test the reference never had for its hand-rolled
+ * collectives (SURVEY.md §4: the reference's only verification is a
+ * human eyeballing the median line); here each primitive is checked in
+ * isolation so a shim bug cannot hide behind an algorithm bug.
+ *
+ * Exit 0 on success; prints the failing check and exits nonzero via
+ * comm_abort otherwise.
+ */
+#include "comm.h"
+
+#include <inttypes.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#define CHECK(c, cond, what)                                               \
+    do {                                                                   \
+        if (!(cond)) {                                                     \
+            char _m[256];                                                  \
+            snprintf(_m, sizeof _m, "comm_selftest FAILED: %s (rank %d)",  \
+                     (what), comm_rank(c));                                \
+            comm_abort((c), 1, _m);                                        \
+        }                                                                  \
+    } while (0)
+
+static void run(comm_ctx *c, void *arg) {
+    (void)arg;
+    const int r = comm_rank(c), P = comm_size(c);
+
+    /* bcast: root's payload reaches everyone */
+    uint64_t v = (r == 0) ? 0xC0FFEEu : 0;
+    comm_bcast(c, &v, sizeof v, 0);
+    CHECK(c, v == 0xC0FFEEu, "bcast");
+
+    /* scatter/gather round-trip: rank r gets block r, returns it */
+    uint32_t *blocks = NULL, got = 0;
+    if (r == 0) {
+        blocks = (uint32_t *)malloc((size_t)P * sizeof(uint32_t));
+        for (int i = 0; i < P; i++) blocks[i] = 100u + (uint32_t)i;
+    }
+    comm_scatter(c, blocks, &got, sizeof got, 0);
+    CHECK(c, got == 100u + (uint32_t)r, "scatter");
+    comm_gather(c, &got, blocks, sizeof got, 0);
+    if (r == 0)
+        for (int i = 0; i < P; i++)
+            CHECK(c, blocks[i] == 100u + (uint32_t)i, "gather");
+
+    /* scatterv/gatherv: ragged blocks of r+1 elements */
+    size_t *cnt = (size_t *)malloc((size_t)P * sizeof(size_t));
+    size_t *dsp = (size_t *)malloc((size_t)P * sizeof(size_t));
+    size_t tot = 0;
+    for (int i = 0; i < P; i++) {
+        cnt[i] = (size_t)(i + 1) * sizeof(uint32_t);
+        dsp[i] = tot;
+        tot += cnt[i];
+    }
+    uint32_t *ragged = NULL;
+    if (r == 0) {
+        ragged = (uint32_t *)malloc(tot);
+        for (size_t i = 0; i < tot / sizeof(uint32_t); i++)
+            ragged[i] = (uint32_t)i;
+    }
+    uint32_t mine[1024];
+    comm_scatterv(c, ragged, cnt, dsp, mine, sizeof mine, 0);
+    for (int i = 0; i <= r; i++)
+        CHECK(c, mine[i] == (uint32_t)(dsp[r] / sizeof(uint32_t) + (size_t)i),
+              "scatterv");
+    for (int i = 0; i <= r; i++) mine[i] += 1000u;
+    comm_gatherv(c, mine, cnt[r], ragged, cnt, dsp, 0);
+    if (r == 0)
+        for (size_t i = 0; i < tot / sizeof(uint32_t); i++)
+            CHECK(c, ragged[i] == 1000u + (uint32_t)i, "gatherv");
+
+    /* allgather */
+    uint32_t *ag = (uint32_t *)malloc((size_t)P * sizeof(uint32_t));
+    uint32_t me32 = 7u * (uint32_t)r + 3u;
+    comm_allgather(c, &me32, ag, sizeof me32);
+    for (int i = 0; i < P; i++)
+        CHECK(c, ag[i] == 7u * (uint32_t)i + 3u, "allgather");
+
+    /* allreduce: sum / min / max, u32 and u64, vector width 3 */
+    uint32_t s32[3] = {(uint32_t)r, 1u, (uint32_t)(r * r)}, o32[3];
+    comm_allreduce(c, s32, o32, 3, COMM_T_U32, COMM_OP_SUM);
+    CHECK(c, o32[1] == (uint32_t)P, "allreduce sum u32");
+    CHECK(c, o32[0] == (uint32_t)(P * (P - 1) / 2), "allreduce sum series");
+    comm_allreduce(c, s32, o32, 3, COMM_T_U32, COMM_OP_MIN);
+    CHECK(c, o32[0] == 0u, "allreduce min");
+    comm_allreduce(c, s32, o32, 3, COMM_T_U32, COMM_OP_MAX);
+    CHECK(c, o32[0] == (uint32_t)(P - 1), "allreduce max");
+    uint64_t s64 = 1ull << (r % 48), o64 = 0;
+    comm_allreduce(c, &s64, &o64, 1, COMM_T_U64, COMM_OP_MAX);
+    CHECK(c, o64 == 1ull << (P - 1 < 48 ? P - 1 : 47), "allreduce max u64");
+
+    /* exscan: rank 0 gets the defined identity, rank r the prefix */
+    uint64_t inc = (uint64_t)r + 1, pre = 42;
+    comm_exscan(c, &inc, &pre, 1, COMM_T_U64, COMM_OP_SUM);
+    CHECK(c, pre == (uint64_t)r * (uint64_t)(r + 1) / 2, "exscan sum");
+    uint32_t one = (uint32_t)r, lowest = 0;
+    comm_exscan(c, &one, &lowest, 1, COMM_T_U32, COMM_OP_MIN);
+    CHECK(c, lowest == (r == 0 ? 0xFFFFFFFFu : 0u), "exscan min identity");
+
+    /* alltoall: block (i -> j) carries i*P+j */
+    uint32_t *sa = (uint32_t *)malloc((size_t)P * sizeof(uint32_t));
+    uint32_t *ra = (uint32_t *)malloc((size_t)P * sizeof(uint32_t));
+    for (int j = 0; j < P; j++) sa[j] = (uint32_t)(r * P + j);
+    comm_alltoall(c, sa, ra, sizeof(uint32_t));
+    for (int i = 0; i < P; i++)
+        CHECK(c, ra[i] == (uint32_t)(i * P + r), "alltoall");
+
+    /* alltoallv: rank i sends j+1 elements to rank j, value i*1000+j */
+    size_t *sc = (size_t *)malloc((size_t)P * sizeof(size_t));
+    size_t *sd = (size_t *)malloc((size_t)P * sizeof(size_t));
+    size_t *rc = (size_t *)malloc((size_t)P * sizeof(size_t));
+    size_t *rd = (size_t *)malloc((size_t)P * sizeof(size_t));
+    size_t off = 0;
+    for (int j = 0; j < P; j++) {
+        sc[j] = (size_t)(j + 1) * sizeof(uint32_t);
+        sd[j] = off;
+        off += sc[j];
+    }
+    uint32_t *sbuf = (uint32_t *)malloc(off);
+    for (int j = 0; j < P; j++)
+        for (int k = 0; k <= j; k++)
+            sbuf[sd[j] / sizeof(uint32_t) + (size_t)k] = (uint32_t)(r * 1000 + j);
+    off = 0;
+    for (int i = 0; i < P; i++) {
+        rc[i] = (size_t)(r + 1) * sizeof(uint32_t);
+        rd[i] = off;
+        off += rc[i];
+    }
+    uint32_t *rbuf = (uint32_t *)malloc(off);
+    comm_alltoallv(c, sbuf, sc, sd, rbuf, rc, rd);
+    for (int i = 0; i < P; i++)
+        for (int k = 0; k <= r; k++)
+            CHECK(c, rbuf[rd[i] / sizeof(uint32_t) + (size_t)k] ==
+                         (uint32_t)(i * 1000 + r), "alltoallv");
+
+    /* wtime monotonic; barrier completes */
+    double t0 = comm_wtime();
+    comm_barrier(c);
+    CHECK(c, comm_wtime() >= t0, "wtime monotonic");
+
+    if (r == 0) printf("comm_selftest OK (%d ranks)\n", P);
+    free(blocks); free(cnt); free(dsp); free(ragged); free(ag);
+    free(sa); free(ra); free(sc); free(sd); free(rc); free(rd);
+    free(sbuf); free(rbuf);
+}
+
+int main(void) { return comm_launch(run, NULL); }

@@ -93,19 +93,14 @@ static void run(comm_ctx *c, void *vs) {
     comm_scatterv(c, all, counts, displs, mine, m * sizeof(uint32_t), 0);
 
     /* -- pass planning: bits above msb(global max^min) are constant -- */
-    uint32_t mm[2], *allmm = (uint32_t *)malloc(2u * (size_t)P * sizeof(uint32_t));
-    mm[0] = m ? mine[0] : 0xFFFFFFFFu;       /* local min (any key) */
-    mm[1] = m ? mine[0] : 0u;                /* local max */
-    for (size_t i = 1; i < m; i++) {
-        if (mine[i] < mm[0]) mm[0] = mine[i];
-        if (mine[i] > mm[1]) mm[1] = mine[i];
+    uint32_t lmin = 0xFFFFFFFFu, lmax = 0; /* identities for empty blocks */
+    for (size_t i = 0; i < m; i++) {
+        if (mine[i] < lmin) lmin = mine[i];
+        if (mine[i] > lmax) lmax = mine[i];
     }
-    comm_allgather(c, mm, allmm, sizeof mm);
-    uint32_t gmin = 0xFFFFFFFFu, gmax = 0;
-    for (int p = 0; p < P; p++) {
-        if (allmm[2 * p] < gmin) gmin = allmm[2 * p];
-        if (allmm[2 * p + 1] > gmax) gmax = allmm[2 * p + 1];
-    }
+    uint32_t gmin, gmax;
+    comm_allreduce(c, &lmin, &gmin, 1, COMM_T_U32, COMM_OP_MIN);
+    comm_allreduce(c, &lmax, &gmax, 1, COMM_T_U32, COMM_OP_MAX);
     uint32_t diff = gmin ^ gmax;
     unsigned need_bits = 0; /* bound the shift: x>>32 is UB on uint32 */
     while (need_bits < 32 && (diff >> need_bits)) need_bits++;
@@ -113,9 +108,14 @@ static void run(comm_ctx *c, void *vs) {
     if (debug && rank == 0)
         printf("[COMMON] 0: %u digit passes of %u bits\n", passes, bits);
 
+    /* comm_exscan/allreduce traffic in uint64; size_t buffers are passed
+     * through directly, which is only sound on LP64. */
+    _Static_assert(sizeof(size_t) == sizeof(uint64_t),
+                   "radix_sort assumes 64-bit size_t");
     size_t *hist = (size_t *)malloc(bins * sizeof(size_t));
     size_t *offs = (size_t *)malloc(bins * sizeof(size_t));
-    size_t *allhist = (size_t *)malloc((size_t)P * bins * sizeof(size_t));
+    size_t *before = (size_t *)malloc(bins * sizeof(size_t));
+    size_t *tot = (size_t *)malloc(bins * sizeof(size_t));
     size_t *scounts = (size_t *)calloc((size_t)P, sizeof(size_t));
     size_t *sdispls = (size_t *)calloc((size_t)P, sizeof(size_t));
     size_t *rcounts = (size_t *)malloc((size_t)P * sizeof(size_t));
@@ -128,23 +128,19 @@ static void run(comm_ctx *c, void *vs) {
         /* local stable counting sort by this digit (+ histogram) */
         counting_sort_digit(mine, tmp, m, shift, bins, hist, offs);
 
-        /* exchange histograms; every rank computes the global layout —
-         * digit_base (exscan over digit totals) and its own run starts.
-         * (The MPI_Gather+prefix+Gatherv root dance, :180-194, becomes a
-         * replicated O(P·bins) loop — tiny next to the key payload.) */
-        comm_allgather(c, hist, allhist, bins * sizeof(size_t));
-        /* my element with digit d, occurrence o sits at global position
-         * digit_base[d] + sum_{r<rank} H[r][d] + o; walk digits in order
-         * accumulating my segment boundaries to get send counts. */
+        /* Global layout from two bins-wide reductions: before[d] =
+         * Σ_{r<rank} hist_r[d] (the MPI_Exscan census row) and tot[d] =
+         * Σ_r hist_r[d].  My element with digit d, occurrence o sits at
+         * global position digit_base[d] + before[d] + o; walk digits in
+         * order accumulating my segment boundaries to get send counts.
+         * (The reference's MPI_Gather+prefix+Gatherv root dance,
+         * :180-194, reduced to O(bins) replicated data per rank.) */
+        comm_exscan(c, hist, before, bins, COMM_T_U64, COMM_OP_SUM);
+        comm_allreduce(c, hist, tot, bins, COMM_T_U64, COMM_OP_SUM);
         memset(scounts, 0, (size_t)P * sizeof(size_t));
         size_t digit_base = 0;
         for (unsigned d = 0; d < bins; d++) {
-            size_t before = 0, tot = 0;
-            for (int r = 0; r < P; r++) {
-                if (r < rank) before += allhist[(size_t)r * bins + d];
-                tot += allhist[(size_t)r * bins + d];
-            }
-            size_t pos = digit_base + before; /* my run of hist[d] keys */
+            size_t pos = digit_base + before[d]; /* my run of hist[d] keys */
             for (size_t o = 0; o < hist[d];) {
                 int owner = block_owner(n, P, pos + o);
                 size_t owner_end = block_start(n, P, owner) + block_count(n, P, owner);
@@ -153,7 +149,7 @@ static void run(comm_ctx *c, void *vs) {
                 scounts[owner] += take * sizeof(uint32_t);
                 o += take;
             }
-            digit_base += tot;
+            digit_base += tot[d];
         }
         size_t acc = 0;
         for (int p = 0; p < P; p++) { sdispls[p] = acc; acc += scounts[p]; }
@@ -181,9 +177,9 @@ static void run(comm_ctx *c, void *vs) {
         print_result(all, n, end - start, debug);
         free(all);
     }
-    free(mine); free(tmp); free(counts); free(displs); free(allmm);
-    free(hist); free(offs); free(allhist); free(scounts); free(sdispls);
-    free(rcounts); free(rdispls); free(recvbuf);
+    free(mine); free(tmp); free(counts); free(displs);
+    free(hist); free(offs); free(before); free(tot); free(scounts);
+    free(sdispls); free(rcounts); free(rdispls); free(recvbuf);
 }
 
 int main(int argc, char **argv) {

@@ -127,26 +127,27 @@ static void run(comm_ctx *c, void *vs) {
     /* -- final local sort + gather to root -------------------------- */
     qsort(bucket, bn, sizeof(uint32_t), cmp_u32);
 
-    size_t my_bytes = bn * sizeof(uint32_t);
+    /* Each rank's output offset is the exclusive prefix of bucket sizes —
+     * comm_exscan (the :188-192 root-side displacement loop, computed
+     * where the data lives); root collects counts+offsets for gatherv. */
+    _Static_assert(sizeof(size_t) == sizeof(uint64_t),
+                   "sample_sort assumes 64-bit size_t");
+    size_t my_bytes = bn * sizeof(uint32_t), my_off = 0;
+    comm_exscan(c, &my_bytes, &my_off, 1, COMM_T_U64, COMM_OP_SUM);
     size_t *gcounts = (size_t *)malloc((size_t)P * sizeof(size_t));
+    size_t *gdispls = (size_t *)malloc((size_t)P * sizeof(size_t));
     comm_gather(c, &my_bytes, gcounts, sizeof(size_t), 0);
-    size_t *gdispls = NULL;
-    if (rank == 0) { /* exclusive prefix sum — the :188-192 displacement step */
-        gdispls = (size_t *)malloc((size_t)P * sizeof(size_t));
-        size_t acc = 0;
-        for (int p = 0; p < P; p++) { gdispls[p] = acc; acc += gcounts[p]; }
-    }
+    comm_gather(c, &my_off, gdispls, sizeof(size_t), 0);
     comm_gatherv(c, bucket, my_bytes, all, gcounts, gdispls, 0);
 
     if (rank == 0) {
         double end = comm_wtime();
         print_result(all, n, end - start, debug);
         free(all);
-        free(gdispls);
     }
     free(mine); free(counts); free(displs); free(samples); free(all_samples);
     free(splitters); free(scounts); free(sdispls); free(rcounts);
-    free(rdispls); free(bucket); free(gcounts);
+    free(rdispls); free(bucket); free(gcounts); free(gdispls);
 }
 
 int main(int argc, char **argv) {

@@ -1,0 +1,25 @@
+# Top-level entry points (SURVEY.md §7.2 step 6: one-command test/CI).
+#
+#   make test    — full verification: Python suite (virtual 8-device CPU
+#                  mesh via tests/conftest.py) + native builds + shim
+#                  selftest + MPI-backend typecheck
+#   make native  — build both sort binaries (local backend) + bench tools
+#   make clean   — remove all build artifacts
+
+PYTHON ?= python3
+
+.PHONY: test native clean
+
+test: native
+	$(PYTHON) -m pytest tests/ -q
+
+native:
+	$(MAKE) -C mpi_sample_sort BACKEND=local
+	$(MAKE) -C mpi_radix_sort BACKEND=local
+	$(MAKE) -C bench BACKEND=local
+	$(MAKE) -C bench mpi-syntax-check
+
+clean:
+	$(MAKE) -C mpi_sample_sort clean
+	$(MAKE) -C mpi_radix_sort clean
+	$(MAKE) -C bench clean

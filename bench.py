@@ -89,9 +89,10 @@ def main() -> None:
     metrics = Metrics(config={"platform": platform, "algo": algo,
                               "log2n": log2n, "dtype": dtype.name,
                               "devices": len(jax.devices())})
-    tracer = Tracer()
     times = []
+    tracer = Tracer()
     for i in range(repeats):
+        tracer = Tracer()  # per-run: counters/phases must not accumulate
         t0 = time.perf_counter()
         r = sort(x_dev, algorithm=algo, mesh=mesh, return_result=True, tracer=tracer)
         for w in r.words:
@@ -107,7 +108,7 @@ def main() -> None:
     metrics.record("baseline_np_sort_mkeys_per_s", round(base_mkeys, 3), "Mkeys/s")
     metrics.record("ingest_gb_per_s", round(x.nbytes / ingest_s / 1e9, 3), "GB/s")
     metrics.throughput("sort_incl_ingest_mkeys_per_s", n, best + ingest_s)
-    metrics.record_phases(tracer.phases)
+    metrics.record_tracer(tracer)  # last run's tracer: per-run values
     metrics.dump()  # structured sidecar → stderr
 
     # The driver contract: exactly one JSON line on stdout.

@@ -20,6 +20,12 @@ here: ``SORT_ALGO`` ∈ {sample, radix} (default sample — the reference
 binary of the same name), ``SORT_RANKS`` (mesh size; default all
 devices), ``SORT_DIGIT_BITS`` (radix digit width, default 8),
 ``SORT_DTYPE`` (default int32).
+
+Observability (SURVEY.md §5 metrics row — additions the reference
+lacks, off by default so the byte-compatible contract is untouched):
+``SORT_METRICS=<path>`` appends one JSON sidecar line per run (phase ms,
+Mkeys/s, exchange bytes + achieved GB/s); ``SORT_PROFILE=<logdir>``
+wraps the sort in a ``jax.profiler`` trace for TensorBoard.
 """
 
 from __future__ import annotations
@@ -54,7 +60,7 @@ def main(argv: list[str] | None = None) -> int:
     from mpitest_tpu.models.api import sort
     from mpitest_tpu.parallel.mesh import make_mesh
     from mpitest_tpu.utils.io import read_keys_text
-    from mpitest_tpu.utils.trace import Tracer
+    from mpitest_tpu.utils.trace import Tracer, jax_profile
 
     tracer = Tracer(level=debug)
     algo = os.environ.get("SORT_ALGO", "sample")
@@ -74,19 +80,40 @@ def main(argv: list[str] | None = None) -> int:
 
     mesh = make_mesh(int(ranks) if ranks else None)
     n_ranks = int(mesh.devices.size)
-    tracer.common(f"Working 0/{n_ranks}", min_level=2)
+    # Per-rank protocol lines, debug>=2 — the reference's shapes
+    # (mpi_sample_sort.c:30 "[COMMON] Working %u/%u", :68 "[SLAVE] %u
+    # Recv(size_input): %u").  One host drives all mesh ranks, so the
+    # lines are emitted in rank order instead of interleaving.
+    for r in range(n_ranks):
+        tracer.common(f"Working {r}/{n_ranks}", min_level=2)
+    tracer.master(f"Read file: {path}")
+    tracer.master(f"File read OK, {n} numbers {keys[0]}-{keys[-1]}.")
+    for r in range(1, n_ranks):
+        tracer.slave(f"{r} Recv(size_input): {n}")
 
     if algo == "sample":
         # ceil(N/P): the reference's size_bucket line (mpi_sample_sort.c:74).
         print(f"Each bucket will be put {-(-n // n_ranks)} items.")
 
     start = time.perf_counter()  # after file read, like MPI_Wtime at :61
-    res = sort(
-        keys, algorithm=algo, mesh=mesh, digit_bits=digit_bits,
-        tracer=tracer, return_result=True,
-    )
-    out = res.to_numpy()  # materialize = the reference's final Gatherv
+    with jax_profile(os.environ.get("SORT_PROFILE")):
+        res = sort(
+            keys, algorithm=algo, mesh=mesh, digit_bits=digit_bits,
+            tracer=tracer, return_result=True,
+        )
+        out = res.to_numpy()  # materialize = the reference's final Gatherv
     end = time.perf_counter()
+
+    metrics_path = os.environ.get("SORT_METRICS")
+    if metrics_path:
+        from mpitest_tpu.utils.metrics import Metrics
+
+        m = Metrics(config={"algo": algo, "n": n, "dtype": dtype.name,
+                            "ranks": n_ranks, "digit_bits": digit_bits})
+        m.record("wall_time_s", round(end - start, 6), "s")
+        m.throughput("sort_mkeys_per_s", n, end - start)
+        m.record_tracer(tracer)
+        m.dump(metrics_path)
 
     if debug > 2:
         mask = (1 << (8 * dtype.itemsize)) - 1

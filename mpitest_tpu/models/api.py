@@ -393,10 +393,22 @@ def sort(
             with tracer.phase("sort"):
                 out, max_cnt = fn(*words)
                 max_cnt = int(max_cnt)
+            # Exchange accounting (SURVEY.md §5 metrics row), counted per
+            # attempt so discarded overflow retries — whose all_to_all
+            # traffic really crossed the links — are included: the padded
+            # exchange ships full [P, cap] word blocks; wire bytes exclude
+            # the self-block, which never leaves the device.
+            tracer.count(
+                "exchange_bytes",
+                passes * n_ranks * (n_ranks - 1) * cap * 4 * codec.n_words,
+            )
             if max_cnt <= cap:
                 break
             tracer.verbose(f"radix exchange overflow (need {max_cnt} > cap {cap}); retrying")
+            tracer.count("exchange_retries", 1)
             cap = _round_cap(max_cnt, align)
+        tracer.count("exchange_passes", passes)
+        tracer.counters["exchange_cap"] = cap  # last cap, not accumulated
         res = DistributedSortResult(out, N, dtype)
     elif algorithm == "sample":
         if oversample is None:
@@ -408,10 +420,16 @@ def sort(
             with tracer.phase("sort"):
                 out, counts, max_cnt = fn(*words)
                 max_cnt = int(max_cnt)
+            tracer.count(
+                "exchange_bytes", n_ranks * (n_ranks - 1) * cap * 4 * codec.n_words
+            )
             if max_cnt <= cap:
                 break
             tracer.verbose(f"sample exchange overflow (need {max_cnt} > cap {cap}); retrying")
+            tracer.count("exchange_retries", 1)
             cap = _round_cap(max_cnt, align)
+        tracer.count("exchange_passes", 1)
+        tracer.counters["exchange_cap"] = cap  # last cap, not accumulated
         counts = np.asarray(counts)
         res = DistributedSortResult(
             out, N, dtype, counts=counts, shard_slots=n_ranks * cap

@@ -30,6 +30,21 @@ class Metrics:
         for name, secs in phases.items():
             self.record(f"phase_{name}_ms", round(secs * 1e3, 3), "ms")
 
+    def record_tracer(self, tracer) -> None:
+        """Fold ONE run's Tracer in: phases, counters, and the achieved
+        exchange bandwidth — the single definition shared by bench.py and
+        the CLI sidecar.  The denominator is the tracer's "sort" phase
+        (the SPMD program span, compute included; the per-pass breakdown
+        lives in a SORT_PROFILE trace).  Pass a per-run Tracer — feeding
+        one accumulated across R runs inflates every value R-fold."""
+        self.record_phases(tracer.phases)
+        for name, v in tracer.counters.items():
+            self.record(name, v)
+        xbytes = tracer.counters.get("exchange_bytes", 0)
+        sort_s = tracer.phases.get("sort")
+        if xbytes and sort_s:
+            self.bandwidth("exchange_gb_per_s", int(xbytes), sort_s)
+
     def throughput(self, name: str, n_keys: int, seconds: float) -> float:
         mkeys = n_keys / seconds / 1e6
         self.record(name, round(mkeys, 3), "Mkeys/s")

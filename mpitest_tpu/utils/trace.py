@@ -20,10 +20,20 @@ from dataclasses import dataclass, field
 
 @dataclass
 class Tracer:
-    """Reference-compatible leveled logger + phase timer."""
+    """Reference-compatible leveled logger + phase timer.
+
+    Prefix vocabulary matches the reference exactly — ``[COMMON]``
+    (any-rank step logs, ``mpi_sample_sort.c:30,87``), ``[MASTER]`` /
+    ``[SLAVE]`` (root / non-root protocol logs, ``:42,68``),
+    ``[VERBOSE]`` (value dumps, ``:84``), ``[ERROR]`` (``:97``).
+    ``counters`` accumulates machine-readable measurements (bytes moved,
+    pass counts) for the metrics sidecar — observability the reference
+    lacks (SURVEY.md §5 metrics row).
+    """
 
     level: int = 0
     phases: dict[str, float] = field(default_factory=dict)
+    counters: dict[str, float] = field(default_factory=dict)
 
     # -- reference printf contract ------------------------------------
     def common(self, msg: str, min_level: int = 1) -> None:
@@ -38,8 +48,17 @@ class Tracer:
         if self.level >= min_level:
             print(f"[MASTER] {msg}")
 
+    def slave(self, msg: str, min_level: int = 2) -> None:
+        """Non-root protocol log (the reference's per-rank Recv lines,
+        ``mpi_sample_sort.c:68,132``)."""
+        if self.level >= min_level:
+            print(f"[SLAVE] {msg}")
+
     def error(self, msg: str) -> None:
         print(f"[ERROR] {msg}", file=sys.stderr)
+
+    def count(self, name: str, value: float) -> None:
+        self.counters[name] = self.counters.get(name, 0.0) + value
 
     # -- additions: per-phase timers ----------------------------------
     @contextmanager

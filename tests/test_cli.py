@@ -54,6 +54,44 @@ def test_output_contract(algo, keyfile, capsys, monkeypatch):
     assert out.err.strip().endswith("sec")
 
 
+def test_debug2_protocol_lines(keyfile, capsys, monkeypatch):
+    """debug>=2 per-rank lines match the reference's prefix vocabulary:
+    [COMMON] Working r/P for every rank (mpi_sample_sort.c:30), [MASTER]
+    read lines (:42,62), [SLAVE] per-rank protocol lines (:68)."""
+    path, _ = keyfile
+    monkeypatch.setenv("SORT_ALGO", "sample")
+    assert sort_cli.main(["sort_cli.py", path, "2"]) == 0
+    out = capsys.readouterr().out
+    for r in range(8):
+        assert f"[COMMON] Working {r}/8" in out
+    assert f"[MASTER] Read file: {path}" in out
+    assert "[MASTER] File read OK, 1000 numbers " in out
+    for r in range(1, 8):
+        assert f"[SLAVE] {r} Recv(size_input): 1000" in out
+
+
+def test_metrics_sidecar_env(keyfile, capsys, monkeypatch, tmp_path):
+    """SORT_METRICS=<path> appends one JSON line with phases, throughput,
+    exchange bytes and achieved GB/s (SURVEY.md §5 metrics row)."""
+    import json
+
+    path, _ = keyfile
+    sidecar = tmp_path / "metrics.jsonl"
+    monkeypatch.setenv("SORT_ALGO", "radix")
+    monkeypatch.setenv("SORT_METRICS", str(sidecar))
+    assert sort_cli.main(["sort_cli.py", path]) == 0
+    capsys.readouterr()
+    lines = sidecar.read_text().strip().splitlines()
+    assert len(lines) == 1
+    obj = json.loads(lines[0])
+    assert obj["config"]["algo"] == "radix" and obj["config"]["ranks"] == 8
+    m = obj["metrics"]
+    assert m["sort_mkeys_per_s"]["value"] > 0
+    assert m["exchange_bytes"]["value"] > 0
+    assert m["exchange_gb_per_s"]["unit"] == "GB/s"
+    assert any(k.startswith("phase_") for k in m)
+
+
 def test_debug_dump_sorted(keyfile, capsys, monkeypatch):
     path, keys = keyfile
     monkeypatch.setenv("SORT_ALGO", "radix")

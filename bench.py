@@ -1,50 +1,105 @@
 """Benchmark driver: one JSON metric line on stdout, details on stderr.
 
-Primary metric (BASELINE.md row 4): radix-sort throughput in Mkeys/s on
-the flagship device-resident path.  ``vs_baseline`` is the ratio against
-the host-CPU baseline sorting the same keys (``np.sort``, a stand-in for
-the reference's host-CPU MPI ranks, which need an mpirun this image lacks;
-the native pthreads backend is measured separately in bench/).
+Primary metric (BASELINE.md rows 3-4): sort throughput in Mkeys/s on the
+flagship device-resident path at the driver-specified scale (2^28
+default on TPU; 2^30 via BENCH_LOG2N=30 when HBM allows).
+
+``vs_baseline`` is the north-star ratio (BASELINE.json): this framework
+vs the repo's OWN native backend at 8 host-CPU ranks sorting the same
+keys at the same N — the moral equivalent of the reference's
+``mpirun -np 8`` on one host.  ``vs_np_sort`` (single-core ``np.sort``)
+is reported as a secondary field.
 
 The timed span is the framework's steady-state contract: keys start and
-end **device-resident and sharded on the mesh** (the design removes every
-root/host round-trip the reference pays — SURVEY.md §5 long-context row),
-so the metric times encode + full multi-pass SPMD sort to completion.
-The host→device ingest cost (which on this image rides a network tunnel
-at ~0.13 GB/s, nothing like production PCIe/DMA) is measured once and
-reported separately in the stderr sidecar, as is the reference-span
-number that includes it.
+end **device-resident and sharded on the mesh** (the design removes
+every root/host round-trip the reference pays — SURVEY.md §5
+long-context row), so the metric times encode + full SPMD sort to
+completion.  The host→device ingest cost (which on this image rides a
+network tunnel at ~0.3 GB/s, nothing like production PCIe/DMA) is
+measured once and reported separately in the stderr sidecar, as is the
+ingest-inclusive throughput.  Note the per-dispatch overhead of this
+image's tunnel (~0.18 s fixed per jit call round-trip, measured by
+chained-call subtraction) is part of every timed run; it amortizes with
+N, which is one reason the target scale is 2^28+.
 
-Env knobs: BENCH_LOG2N (default 26 on TPU, 20 on CPU), BENCH_ALGO
-(radix|sample), BENCH_REPEATS (default 3), BENCH_DTYPE (int32).
+Env knobs: BENCH_LOG2N (default 28 on TPU, 20 on CPU), BENCH_ALGO
+(radix|sample), BENCH_REPEATS (default 3), BENCH_DTYPE (int32),
+BENCH_NATIVE_RANKS (default 8; 0 disables the native denominator).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import shutil
+import subprocess
 import sys
+import tempfile
 import time
+from pathlib import Path
 
 import numpy as np
+
+REPO = Path(__file__).resolve().parent
 
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def measure_native(x: np.ndarray, algo: str, ranks: int) -> float | None:
+    """Run the repo's native backend (pthreads, `ranks` host-CPU ranks) on
+    the same keys; return its own timer's seconds (the reference span:
+    after-read through final gather), or None if unavailable.  Never
+    raises: a missing toolchain / full /tmp / timeout must not cost the
+    already-measured TPU result its stdout JSON line."""
+    try:
+        if x.dtype != np.int32:
+            log("native baseline: skipped (int32 only)")
+            return None
+        if shutil.which("cc") is None and shutil.which("gcc") is None:
+            log("native baseline: skipped (no C compiler)")
+            return None
+        d = "mpi_radix_sort" if algo == "radix" else "mpi_sample_sort"
+        binary = REPO / d / ("radix_sort" if algo == "radix" else "sample_sort")
+        r = subprocess.run(["make", "-C", str(REPO / d), "BACKEND=local"],
+                           capture_output=True, text=True)
+        if r.returncode != 0:
+            log(f"native baseline: build failed: {r.stderr[-500:]}")
+            return None
+        from mpitest_tpu.utils.io import write_keys_binary
+        from mpitest_tpu.utils.nativebench import run_native_sort
+
+        with tempfile.NamedTemporaryFile(suffix=".bin", delete=False) as f:
+            path = f.name
+        try:
+            write_keys_binary(path, x)
+            secs, err = run_native_sort(binary, path, ranks)
+            if err:
+                log(f"native baseline: {err}")
+            return secs
+        finally:
+            os.unlink(path)
+    except Exception as e:  # noqa: BLE001 — baseline is best-effort
+        log(f"native baseline: failed ({type(e).__name__}: {e})")
+        return None
+
+
 def main() -> None:
     import jax
 
     from mpitest_tpu.models.api import sort
-    from mpitest_tpu.parallel.mesh import make_mesh
+    from mpitest_tpu.parallel.mesh import key_sharding, make_mesh
+    from mpitest_tpu.utils.metrics import Metrics
+    from mpitest_tpu.utils.trace import Tracer
 
     platform = jax.devices()[0].platform
     on_tpu = platform not in ("cpu",)
-    log2n = int(os.environ.get("BENCH_LOG2N", "26" if on_tpu else "20"))
+    log2n = int(os.environ.get("BENCH_LOG2N", "28" if on_tpu else "20"))
     algo = os.environ.get("BENCH_ALGO", "radix")
     repeats = int(os.environ.get("BENCH_REPEATS", "3"))
     dtype = np.dtype(os.environ.get("BENCH_DTYPE", "int32"))
+    native_ranks = int(os.environ.get("BENCH_NATIVE_RANKS", "8"))
     n = 1 << log2n
 
     log(f"bench: platform={platform} devices={len(jax.devices())} "
@@ -55,16 +110,15 @@ def main() -> None:
     x = rng.integers(info.min, info.max, size=n, dtype=dtype, endpoint=True)
     mesh = make_mesh()
 
-    # Host-CPU baseline: same keys, single-node sort.
+    # Secondary baseline: single-core np.sort of the same keys (also the
+    # correctness reference for the median probe).
     t0 = time.perf_counter()
-    ref = np.sort(x)
-    base_s = time.perf_counter() - t0
-    base_mkeys = n / base_s / 1e6
-    log(f"baseline np.sort: {base_s:.3f}s = {base_mkeys:.1f} Mkeys/s")
+    ref_median = int(np.sort(x)[n // 2 - 1])
+    np_s = time.perf_counter() - t0
+    np_mkeys = n / np_s / 1e6
+    log(f"baseline np.sort: {np_s:.3f}s = {np_mkeys:.1f} Mkeys/s")
 
     # Ingest: place the keys on the mesh once (untimed; rate recorded).
-    from mpitest_tpu.parallel.mesh import key_sharding
-
     t0 = time.perf_counter()
     x_dev = jax.device_put(x, key_sharding(mesh))
     x_dev.block_until_ready()
@@ -74,49 +128,82 @@ def main() -> None:
     # Warmup: compiles the program and settles the exchange cap.
     res = sort(x_dev, algorithm=algo, mesh=mesh, return_result=True)
     probe = res.median_probe()
-    expect = int(ref[n // 2 - 1])
-    ok = probe == expect
-    log(f"median probe: got {probe} expect {expect} ({'OK' if ok else 'MISMATCH'})")
+    ok = probe == ref_median
+    del res  # free the result buffers: at 2^30 two live results OOM HBM
+    log(f"median probe: got {probe} expect {ref_median} ({'OK' if ok else 'MISMATCH'})")
+    metric_name = f"{algo}_sort_mkeys_per_s_2e{log2n}_{dtype.name}"
     if not ok:
         log("CORRECTNESS FAILURE — reporting value 0")
-        print(json.dumps({"metric": f"{algo}_sort_mkeys_per_s_2e{log2n}_{dtype.name}",
-                          "value": 0.0, "unit": "Mkeys/s", "vs_baseline": 0.0}))
+        print(json.dumps({"metric": metric_name, "value": 0.0,
+                          "unit": "Mkeys/s", "vs_baseline": 0.0}))
         return
-
-    from mpitest_tpu.utils.metrics import Metrics
-    from mpitest_tpu.utils.trace import Tracer
 
     metrics = Metrics(config={"platform": platform, "algo": algo,
                               "log2n": log2n, "dtype": dtype.name,
                               "devices": len(jax.devices())})
     times = []
-    tracer = Tracer()
+    tracer = Tracer()  # tracer of the last COMPLETED run (sidecar source)
     for i in range(repeats):
-        tracer = Tracer()  # per-run: counters/phases must not accumulate
+        run_tracer = Tracer()  # per-run: counters/phases must not accumulate
         t0 = time.perf_counter()
-        r = sort(x_dev, algorithm=algo, mesh=mesh, return_result=True, tracer=tracer)
-        for w in r.words:
-            w.block_until_ready()
-        # block_until_ready is advisory on the axon tunnel; force a sync.
-        jax.device_get(r.words[0][-1:])
+        try:
+            r = sort(x_dev, algorithm=algo, mesh=mesh, return_result=True,
+                     tracer=run_tracer)
+            for w in r.words:
+                w.block_until_ready()
+            # block_until_ready is advisory on the axon tunnel; force a sync.
+            jax.device_get(r.words[0][-1:])
+        except jax.errors.JaxRuntimeError as e:
+            # Near the HBM limit (2^30 = 4 GB keys on a 16 GB chip) the
+            # previous run's buffers may not have deallocated yet; keep
+            # whatever repeats completed rather than losing the result.
+            if "RESOURCE_EXHAUSTED" not in str(e) or not times:
+                raise
+            log(f"run {i}: skipped (HBM exhausted; keeping {len(times)} runs)")
+            break
         dt = time.perf_counter() - t0
+        del r  # free before the next run (2^30: two live results OOM)
         times.append(dt)
+        tracer = run_tracer
         log(f"run {i}: {dt:.3f}s = {n/dt/1e6:.1f} Mkeys/s")
 
     best = min(times)
     mkeys = metrics.throughput("sort_mkeys_per_s", n, best)
-    metrics.record("baseline_np_sort_mkeys_per_s", round(base_mkeys, 3), "Mkeys/s")
+
+    # North-star denominator: the native backend, 8 host-CPU ranks, same
+    # keys, same N (BASELINE.json: ">=8x the throughput of 8-rank
+    # host-CPU MPI"; the pthreads backend is the same shared-memory
+    # transport class mpirun uses on one host).
+    vs_native = None
+    if native_ranks > 0:
+        native_s = measure_native(x, algo, native_ranks)
+        if native_s is not None:
+            native_mkeys = n / native_s / 1e6
+            vs_native = mkeys / native_mkeys
+            log(f"native {algo} x{native_ranks} ranks: {native_s:.3f}s = "
+                f"{native_mkeys:.1f} Mkeys/s -> vs_native = {vs_native:.2f}x")
+            metrics.record(f"native_{native_ranks}rank_mkeys_per_s",
+                           round(native_mkeys, 3), "Mkeys/s")
+
+    metrics.record("baseline_np_sort_mkeys_per_s", round(np_mkeys, 3), "Mkeys/s")
     metrics.record("ingest_gb_per_s", round(x.nbytes / ingest_s / 1e9, 3), "GB/s")
     metrics.throughput("sort_incl_ingest_mkeys_per_s", n, best + ingest_s)
     metrics.record_tracer(tracer)  # last run's tracer: per-run values
     metrics.dump()  # structured sidecar → stderr
 
-    # The driver contract: exactly one JSON line on stdout.
+    # The driver contract: exactly one JSON line on stdout.  vs_baseline
+    # is the north-star ratio (vs 8-rank native); when that baseline
+    # could not run, the fallback denominator is named in "baseline" so
+    # a consumer can never mistake np.sort for the 8-rank target.
+    vs_baseline = vs_native if vs_native is not None else mkeys / np_mkeys
     print(json.dumps({
-        "metric": f"{algo}_sort_mkeys_per_s_2e{log2n}_{dtype.name}",
+        "metric": metric_name,
         "value": round(mkeys, 2),
         "unit": "Mkeys/s",
-        "vs_baseline": round(mkeys / base_mkeys, 3),
+        "vs_baseline": round(vs_baseline, 3),
+        "baseline": (f"native_{native_ranks}rank" if vs_native is not None
+                     else "np_sort"),
+        "vs_np_sort": round(mkeys / np_mkeys, 3),
     }))
 
 

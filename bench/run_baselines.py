@@ -20,7 +20,6 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import re
 import subprocess
 import sys
 import time
@@ -38,15 +37,13 @@ def emit(obj: dict) -> None:
 
 
 def run_native(tag: str, binary: Path, path: str, ranks: int) -> None:
-    r = subprocess.run(
-        [str(binary), path], capture_output=True, text=True,
-        env=dict(os.environ, COMM_RANKS=str(ranks)), timeout=600,
-    )
-    m = re.search(r"Endtime\(\)-Starttime\(\) = ([0-9.]+) sec", r.stderr)
-    if r.returncode != 0 or not m:
-        emit({"config": tag, "error": r.stderr.strip()[:200]})
+    from mpitest_tpu.utils.nativebench import run_native_sort
+
+    secs, err = run_native_sort(binary, path, ranks, timeout=600)
+    if err:
+        emit({"config": tag, "error": err[:200]})
         return
-    emit({"config": tag, "metric": "wall_time_s", "value": float(m.group(1)),
+    emit({"config": tag, "metric": "wall_time_s", "value": secs,
           "ranks": ranks})
 
 

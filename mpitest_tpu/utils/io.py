@@ -14,8 +14,35 @@ from __future__ import annotations
 import numpy as np
 
 
+#: Binary key-file header (mirrored in native/sort_common.h): 8 bytes of
+#: b"SORTBIN1", 1 byte numpy dtype kind (b'i'/b'u'), 1 byte itemsize,
+#: 6 pad bytes, then raw little-endian keys.  The dtype tag makes a
+#: width/signedness mismatch a hard error instead of silent regrouping.
+BIN_MAGIC = b"SORTBIN1"
+BIN_HEADER_LEN = 16
+
+
+def _bin_header(dtype: np.dtype) -> bytes:
+    return BIN_MAGIC + dtype.kind.encode() + bytes([dtype.itemsize]) + b"\0" * 6
+
+
+def _check_bin_header(header: bytes, path: str, dtype: np.dtype) -> None:
+    kind, itemsize = chr(header[8]), header[9]
+    if (kind, itemsize) != (dtype.kind, dtype.itemsize):
+        raise ValueError(
+            f"'{path}' holds {kind}{itemsize * 8} keys, not {dtype.name}"
+        )
+
+
 def read_keys_text(path: str, dtype=np.int32) -> np.ndarray:
-    """Read whitespace-separated decimal integers (reference input format)."""
+    """Read keys: the reference's whitespace-separated decimal format, or
+    the SORTBIN1 binary fast path when the magic header is present (both
+    the CLI and the native binaries sniff the same magic)."""
+    with open(path, "rb") as f:
+        head = f.read(BIN_HEADER_LEN)
+        if head[:8] == BIN_MAGIC:
+            _check_bin_header(head, path, np.dtype(dtype))
+            return np.frombuffer(f.read(), dtype=dtype).copy()
     dt = np.dtype(dtype)
     if dt == np.dtype(np.uint64):
         # int64 intermediate would saturate keys above 2^63-1; parse exactly.
@@ -34,13 +61,21 @@ def write_keys_text(path: str, keys: np.ndarray) -> None:
 
 
 def read_keys_binary(path: str, dtype=np.int32) -> np.ndarray:
-    """Binary fast path: raw little-endian keys (for 2^30-scale benches,
-    where text parsing would dominate the measured span's setup)."""
-    return np.fromfile(path, dtype=dtype)
+    """Binary fast path: SORTBIN1 header + raw little-endian keys (for
+    2^28+-scale benches, where text parsing would dominate setup)."""
+    with open(path, "rb") as f:
+        head = f.read(BIN_HEADER_LEN)
+        if head[:8] != BIN_MAGIC:
+            raise ValueError(f"'{path}' is not a SORTBIN1 key file")
+        _check_bin_header(head, path, np.dtype(dtype))
+        return np.frombuffer(f.read(), dtype=dtype).copy()
 
 
 def write_keys_binary(path: str, keys: np.ndarray) -> None:
-    np.asarray(keys).tofile(path)
+    keys = np.asarray(keys).reshape(-1)
+    with open(path, "wb") as f:
+        f.write(_bin_header(keys.dtype))
+        keys.tofile(f)
 
 
 def generate_uniform(n: int, dtype=np.int32, seed: int = 0) -> np.ndarray:

@@ -1,0 +1,41 @@
+"""Shared runner for the native sort binaries' timer contract.
+
+Both benchmark drivers (bench.py's north-star denominator and
+bench/run_baselines.py's reference rows) execute a native binary and
+scrape its stderr timer line (``Endtime()-Starttime() = %.5f sec``,
+native/sort_common.h print_result).  One copy of the invocation + regex
+lives here so the contract cannot drift between them.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+
+TIMER_RE = re.compile(r"Endtime\(\)-Starttime\(\) = ([0-9.]+) sec")
+
+
+def run_native_sort(binary, path, ranks: int, timeout: int = 3600,
+                    debug: int = 0):
+    """Run a native sort binary (local backend, ``ranks`` pthread ranks)
+    on key file ``path``.
+
+    Returns ``(elapsed_seconds, None)`` on success — the binary's OWN
+    timer span (after-read through final gather, the reference contract)
+    — or ``(None, error_message)`` on any failure; never raises.
+    """
+    argv = [str(binary), str(path)] + ([str(debug)] if debug else [])
+    try:
+        r = subprocess.run(
+            argv, capture_output=True, text=True, timeout=timeout,
+            env=dict(os.environ, COMM_RANKS=str(ranks)),
+        )
+    except (OSError, subprocess.SubprocessError) as e:
+        return None, f"{type(e).__name__}: {e}"
+    if r.returncode != 0:
+        return None, (r.stderr.strip() or "nonzero exit")[-300:]
+    m = TIMER_RE.search(r.stderr)
+    if not m:
+        return None, "no timer line in stderr"
+    return float(m.group(1)), None

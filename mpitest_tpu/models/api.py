@@ -271,6 +271,37 @@ def _compile_sample(mesh: Mesh, n_words: int, n: int, cap: int, oversample: int,
     )
 
 
+#: Recv-memory bound for the sample-sort exchange, in units of the fair
+#: per-peer share ceil(n/P).  The [P, cap] recv buffer is then at most
+#: 8·n words per device — O(n), never O(N) — and inputs needing more
+#: (heavy duplication: every copy of a hot key routes to one splitter
+#: interval) fall back to radix, whose destination = exact global
+#: position is skew-immune by construction (SURVEY.md §7.3 Zipf config).
+SAMPLE_CAP_LIMIT_FACTOR = 8
+
+
+def _sample_skew_sniff(words_np: tuple[np.ndarray, ...], n_ranks: int) -> bool:
+    """Cheap host-side skew detector: would quantile splitters degenerate?
+
+    Takes an evenly-strided ~32·P-key sample, sorts it, and picks the same
+    P-1 quantiles the SPMD program would.  Two *equal adjacent* splitters
+    mean at least 2/P of the sample mass sits on one key value — every
+    copy would route to a single destination and the exchange cap would
+    blow through the O(n) bound, so route to radix up front instead of
+    discovering it via a failed exchange round.  (Duplication below that
+    threshold keeps splitters distinct and the cap bounded; the reactive
+    in-loop bound still catches anything the sample misses.)
+    """
+    n_total = words_np[0].size
+    s = min(n_total, max(64, 32 * n_ranks))
+    idx = np.linspace(0, n_total - 1, s).astype(np.int64)
+    # lexsort: last key is primary → feed words lsw-first.
+    order = np.lexsort(tuple(w[idx] for w in reversed(words_np)))
+    qpos = (np.arange(1, n_ranks) * s) // n_ranks
+    picks = [tuple(int(w[idx[order[q]]]) for w in words_np) for q in qpos]
+    return any(a == b for a, b in zip(picks, picks[1:]))
+
+
 def _shard_input(words_np, mesh, n, pad_words=None):
     P_ = mesh.devices.size
     sharding = key_sharding(mesh)
@@ -300,6 +331,14 @@ def sort(
     pass count) or ``"sample"`` (one exchange round; cap-sensitive under
     skew).  Both produce identical bytes — sorted output is canonical.
 
+    Skew fallback (SURVEY.md §7.3): ``"sample"`` inputs whose quantile
+    splitters would degenerate (heavy duplication — the Zipf stress
+    config) route to radix automatically, either up front (host-side
+    sniff, :func:`_sample_skew_sniff`) or reactively when the exchange
+    cap would exceed the O(n)-per-device bound
+    (:data:`SAMPLE_CAP_LIMIT_FACTOR`); ``tracer.counters
+    ["sample_skew_fallback"]`` records the reroute.
+
     ``x`` may be a host array OR a device-resident ``jax.Array`` (any
     supported dtype — 64-bit device arrays exist only under
     ``jax_enable_x64`` and split into uint32 words on-device): the device
@@ -307,6 +346,8 @@ def sort(
     the host — the framework's steady-state contract (keys live sharded
     on the mesh; SURVEY.md §5 long-context row).
     """
+    if algorithm not in ("radix", "sample"):
+        raise ValueError(f"unknown algorithm {algorithm!r}")
     tracer = tracer or Tracer()
     is_device = isinstance(x, jax.Array)
     if not is_device:
@@ -375,7 +416,57 @@ def sort(
     align = _cap_align(pack_impl)
     cap = _round_cap(int(n / n_ranks * cap_factor) + 1, align)
 
-    if algorithm == "radix":
+    res = None
+    if algorithm == "sample":
+        if oversample is None:
+            oversample = max(2 * n_ranks - 1, 8)
+        oversample = min(oversample, n)
+        if words_np is not None and _sample_skew_sniff(words_np, n_ranks):
+            tracer.verbose(
+                "sample: quantile splitters degenerate (heavy duplication); "
+                "routing to radix (skew-immune)"
+            )
+            tracer.count("sample_skew_fallback", 1)
+            algorithm = "radix"
+        else:
+            cap_limit = _round_cap(
+                SAMPLE_CAP_LIMIT_FACTOR * max(1, -(-n // n_ranks)), align
+            )
+            while True:
+                fn = _compile_sample(mesh, codec.n_words, n, cap, oversample,
+                                     pack_impl)
+                with tracer.phase("sort"):
+                    out, counts, max_cnt = fn(*words)
+                    max_cnt = int(max_cnt)
+                tracer.count(
+                    "exchange_bytes",
+                    n_ranks * (n_ranks - 1) * cap * 4 * codec.n_words,
+                )
+                if max_cnt <= cap:
+                    break
+                need = _round_cap(max_cnt, align)
+                if need > cap_limit:
+                    tracer.verbose(
+                        f"sample exchange needs cap {max_cnt} > O(n) bound "
+                        f"{cap_limit}; routing to radix (skew-immune)"
+                    )
+                    tracer.count("sample_skew_fallback", 1)
+                    algorithm = "radix"
+                    cap = _round_cap(int(n / n_ranks * cap_factor) + 1, align)
+                    break
+                tracer.verbose(
+                    f"sample exchange overflow (need {max_cnt} > cap {cap}); retrying")
+                tracer.count("exchange_retries", 1)
+                cap = need
+            if algorithm == "sample":
+                tracer.count("exchange_passes", 1)
+                tracer.counters["exchange_cap"] = cap
+                counts = np.asarray(counts)
+                res = DistributedSortResult(
+                    out, N, dtype, counts=counts, shard_slots=n_ranks * cap
+                )
+
+    if res is None and algorithm == "radix":
         with tracer.phase("plan"):
             if words_np is None:
                 # Device-resident input: one scalar min/max sync per word
@@ -410,32 +501,7 @@ def sort(
         tracer.count("exchange_passes", passes)
         tracer.counters["exchange_cap"] = cap  # last cap, not accumulated
         res = DistributedSortResult(out, N, dtype)
-    elif algorithm == "sample":
-        if oversample is None:
-            oversample = max(2 * n_ranks - 1, 8)
-        oversample = min(oversample, n)
-        while True:
-            fn = _compile_sample(mesh, codec.n_words, n, cap, oversample,
-                                 pack_impl)
-            with tracer.phase("sort"):
-                out, counts, max_cnt = fn(*words)
-                max_cnt = int(max_cnt)
-            tracer.count(
-                "exchange_bytes", n_ranks * (n_ranks - 1) * cap * 4 * codec.n_words
-            )
-            if max_cnt <= cap:
-                break
-            tracer.verbose(f"sample exchange overflow (need {max_cnt} > cap {cap}); retrying")
-            tracer.count("exchange_retries", 1)
-            cap = _round_cap(max_cnt, align)
-        tracer.count("exchange_passes", 1)
-        tracer.counters["exchange_cap"] = cap  # last cap, not accumulated
-        counts = np.asarray(counts)
-        res = DistributedSortResult(
-            out, N, dtype, counts=counts, shard_slots=n_ranks * cap
-        )
-    else:
-        raise ValueError(f"unknown algorithm {algorithm!r}")
+    assert res is not None
 
     if return_result:
         return res

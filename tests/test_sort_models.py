@@ -83,6 +83,67 @@ def test_zipf_skew(algo, mesh8):
     np.testing.assert_array_equal(got, np.sort(x))
 
 
+def test_zipf_sample_routes_to_radix(mesh8):
+    """SURVEY.md §7.3 Zipf fallback: under heavy duplication the sample
+    path must keep recv memory O(n)/device by rerouting to radix (whose
+    dest = exact global position is skew-immune), not by growing the cap
+    toward the full shard size."""
+    from mpitest_tpu.models.api import SAMPLE_CAP_LIMIT_FACTOR
+    from mpitest_tpu.utils.trace import Tracer
+
+    # Zipf(1.5): the top value carries ~38% of the mass (1/zeta(1.5)) —
+    # far above the 1/P=12.5% fair share, so splitters degenerate.
+    x = io.generate_zipf(1 << 16, a=1.5, dtype=np.int64, seed=3)
+    tracer = Tracer()
+    got = sort(x, algorithm="sample", mesh=mesh8, tracer=tracer)
+    np.testing.assert_array_equal(got, np.sort(x))
+    assert tracer.counters.get("sample_skew_fallback", 0) >= 1
+    n_shard = -(-x.size // 8)
+    assert tracer.counters["exchange_cap"] <= max(
+        SAMPLE_CAP_LIMIT_FACTOR * -(-n_shard // 8) + 1024, 1024
+    )
+
+
+def test_zipf11_sample_stays_bounded(mesh8):
+    """Zipf(1.1) at 8 ranks is heavy-tailed but NOT degenerate (top value
+    ~9.5% < 1/P): the sample path must handle it with bounded cap and no
+    fallback — the reroute is for genuinely pathological duplication."""
+    from mpitest_tpu.models.api import SAMPLE_CAP_LIMIT_FACTOR
+    from mpitest_tpu.utils.trace import Tracer
+
+    x = io.generate_zipf(1 << 15, dtype=np.int64, seed=3)
+    tracer = Tracer()
+    got = sort(x, algorithm="sample", mesh=mesh8, tracer=tracer)
+    np.testing.assert_array_equal(got, np.sort(x))
+    assert tracer.counters.get("sample_skew_fallback", 0) == 0
+    n_shard = -(-x.size // 8)
+    assert tracer.counters["exchange_cap"] <= SAMPLE_CAP_LIMIT_FACTOR * -(-n_shard // 8) + 1024
+
+
+def test_skew_sniff_thresholds():
+    """The host-side sniff fires on degenerate quantiles, not on benign
+    duplication."""
+    from mpitest_tpu.models.api import _sample_skew_sniff
+    from mpitest_tpu.ops.keys import codec_for
+
+    rng = np.random.default_rng(0)
+    uniform = codec_for(np.dtype(np.int32)).encode(
+        rng.integers(-(2**31), 2**31 - 1, size=10_000, dtype=np.int32))
+    assert not _sample_skew_sniff(uniform, 8)
+    zipf = codec_for(np.dtype(np.int64)).encode(
+        io.generate_zipf(10_000, a=1.5, dtype=np.int64, seed=1))
+    assert _sample_skew_sniff(zipf, 8)
+    # Zipf(1.1) at 8 ranks: heavy-tailed but below the 2/P degeneracy
+    # threshold — must NOT fire (it sorts fine with a bounded cap).
+    zipf11 = codec_for(np.dtype(np.int64)).encode(
+        io.generate_zipf(10_000, a=1.1, dtype=np.int64, seed=1))
+    assert not _sample_skew_sniff(zipf11, 8)
+    # all-equal keys: maximally degenerate
+    const = codec_for(np.dtype(np.int32)).encode(
+        np.full(5000, 7, dtype=np.int32))
+    assert _sample_skew_sniff(const, 8)
+
+
 @pytest.mark.parametrize("algo", ALGOS)
 def test_sorted_and_reverse_inputs(algo, mesh8):
     x = np.arange(-500, 500, dtype=np.int32)

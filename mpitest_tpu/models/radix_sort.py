@@ -30,15 +30,21 @@ The TPU design is different in three load-bearing ways:
    (the reference couples radix to P, ``mpi_radix_sort.c:64``) and digits
    are shift/mask, not ``pow()`` (``mpi_radix_sort.c:54-58``).
 
-Monotonicity property used by the exchange: after the local stable sort by
-digit, ``dest`` is strictly increasing, so each destination device's keys
-form one contiguous segment — exactly what
-:func:`~mpitest_tpu.parallel.collectives.ragged_all_to_all` ships.
+**One sort per pass.**  A naive receiver re-sorts the [P, cap] exchange
+buffer by digit to merge it (a second full comparison sort every pass —
+round-1 design, flagged by its review).  Here the receiver instead
+*computes* each incoming lane's exact slot from information it already
+has replicated — sender s's segment start toward me and the (base − lo)
+step function of s's digit runs, all derived from the H matrix (see
+:func:`_lane_slots`; everything is K-element scatters, row cumsums and
+``searchsorted``, no per-element gathers) — and the next pass's single
+``lax.sort`` keyed on ``(next_digit, slot)`` performs the pending merge
+and the new digit grouping in one fused pass.  The pending merge of the
+*last* pass is materialized by one 1-key sort on ``slot``.
 
 Stability across ranks matches the reference's in-rank-order Recv loop
-(``mpi_radix_sort.c:168-173``); the scatter at the receiver is
-deterministic (every key lands at its computed offset), so output is
-bit-identical run to run — arrival order never matters.
+(``mpi_radix_sort.c:168-173``): ``slot`` IS the exact global position,
+so output is bit-identical run to run — arrival order never matters.
 """
 
 from __future__ import annotations
@@ -54,62 +60,59 @@ from mpitest_tpu.parallel.mesh import AXIS
 Words = tuple[jax.Array, ...]
 
 
-def _one_pass(words: Words, word_idx: int, shift: int, digit_bits: int,
-              n_ranks: int, cap: int, axis: str,
-              pack: str = "xla") -> tuple[Words, jax.Array]:
-    """One LSD pass, built only from TPU-fast primitives: fused multi-
-    operand ``lax.sort``, ``searchsorted`` over sorted data, cumsum, and
-    K-element scatters (K = bins or ranks).  Per-element gathers/scatters
-    — the straightforward translation of the reference's bucket loops —
-    measured 10-40× slower than a sort at 2^26 on v5e, so none appear on
-    the per-key path."""
-    n = words[0].shape[0]
-    n_bins = 1 << digit_bits
-    my = lax.axis_index(axis)
+def _lane_slots(recv_cnt: jax.Array, H: jax.Array, digit_base: jax.Array,
+                rank_base: jax.Array, n: int, cap: int,
+                axis: str) -> jax.Array:
+    """Local output slot of every received lane, from replicated state.
 
-    # Group keys by digit: ONE fused stable sort carries all key words.
-    d = kernels.digit_at(words[word_idx], shift, digit_bits)
-    ops = lax.sort([d] + list(words), num_keys=1, is_stable=True)
-    sd, sorted_words = ops[0], tuple(ops[1:])
+    Lane (s, c) of the exchange buffer holds element ``j = j0[s] + c`` of
+    sender s's digit-sorted shard, where ``j0[s]`` is the start of s's
+    segment toward me.  Its pass destination is
 
-    # Histogram + first-occurrence offsets from the sorted digits (no scatter).
-    h, lo = kernels.histogram_sorted(sd, n_bins)
+        dest = base[s, d] + (j - lo[s, d]),   d = digit of the key,
 
-    _, tot, rank_base = coll.exscan_counts(h, axis)
-    digit_base = coll.exclusive_cumsum(tot)
-    base = digit_base + rank_base[my]          # [bins] my global run starts
+    with ``base[s, d] = digit_base[d] + rank_base[s, d]`` (s's global run
+    start for digit d) and ``lo[s, d]`` the run start *within* s's shard
+    — both functions of the replicated H matrix, so nothing extra rides
+    the wire.  Since lanes within a row arrive digit-sorted, the gather
+    ``(base - lo)[s, d(c)]`` is a per-row step function whose run
+    boundaries in lane space are ``lo[s, ·] - j0[s]`` — the digit values
+    themselves are never touched: K-element scatter + cumsum
+    (:func:`kernels.piecewise_fill`), never a per-element gather
+    (10-40x a sort's cost on v5e; see ops/kernels.py).
 
-    # dest[j] = base[sd[j]] + (j - lo[sd[j]]): the step function
-    # (base - lo)[sd[j]] materialized gather-free, plus iota.
-    dest = kernels.piecewise_fill(lo, base - lo, n) + lax.iota(jnp.int32, n)
+    Returns int32 [P, cap]: local slot in [0, n) for valid lanes, ``n``
+    for invalid ones.  Valid slots tile [0, n) exactly once — dest
+    partitions the global key space and my block receives exactly n.
+    """
+    me = lax.axis_index(axis)
+    n_ranks = H.shape[0]
+    base = digit_base[None, :] + rank_base          # [P, bins]
+    lo = coll.exclusive_cumsum(H, axis=1)           # [P, bins]
+    # j0[s] = #{keys of s with dest < me*n} = sum_d clip(me*n - base, 0, H)
+    j0 = jnp.clip(me * n - base, 0, H).sum(axis=1).astype(jnp.int32)  # [P]
 
+    # Per-row step function of (base - lo) over the lane axis: run of
+    # digit d occupies lanes [lo[s,d] - j0[s], lo[s,d+1] - j0[s]).
+    starts = jnp.clip(lo - j0[:, None], 0, cap).astype(jnp.int32)     # [P, bins]
+    values = (base - lo).astype(jnp.int32)                            # [P, bins]
+    fill = jax.vmap(kernels.piecewise_fill, in_axes=(0, 0, None))(
+        starts, values, cap
+    )                                                                 # [P, cap]
+
+    c = lax.iota(jnp.int32, cap)[None, :]
+    slot = fill + j0[:, None] + c - me * n
+    valid = c < recv_cnt[:, None]
+    return jnp.where(valid, slot, n).astype(jnp.int32)
+
+
+def _send_segments(sorted_dest: jax.Array, n: int, n_ranks: int):
+    """Contiguous per-destination-device segments of the dest-monotone
+    shard (dest strictly increasing ⇒ one segment per device)."""
     bounds = lax.iota(jnp.int32, n_ranks) * n
-    send_start = jnp.searchsorted(dest, bounds, side="left").astype(jnp.int32)
+    send_start = jnp.searchsorted(sorted_dest, bounds, side="left").astype(jnp.int32)
     seg_end = jnp.concatenate([send_start[1:], jnp.asarray([n], jnp.int32)])
-    send_cnt = seg_end - send_start
-
-    # Keys only on the wire — the receiver recomputes digits from the key
-    # words, so no index payload rides the exchange.
-    recv, recv_cnt, max_cnt = coll.ragged_all_to_all(
-        sorted_words, send_start, send_cnt, cap, n_ranks, axis, pack=pack
-    )
-
-    # Receiver-side placement is a P-way merge by (digit, sender, arrival):
-    # flatten sender-major and stable-sort by digit.  Globally, my n slots
-    # are filled exactly once (dest partitions [0, P·n)), so the valid
-    # lanes sort to a length-n prefix; invalid lanes get digit = n_bins.
-    # This replaces the reference's rank-ordered Recv loop
-    # (mpi_radix_sort.c:168-173) and needs no per-element scatter.
-    rd = kernels.digit_at(recv[word_idx], shift, digit_bits)
-    c = lax.iota(jnp.int32, cap)
-    valid = c[None, :] < recv_cnt[:, None]                           # [P, cap]
-    rd = jnp.where(valid, rd, n_bins)
-    flat = lax.sort(
-        [rd.reshape(-1)] + [r.reshape(-1) for r in recv],
-        num_keys=1, is_stable=True,
-    )
-    out_words = tuple(o[:n] for o in flat[1:])
-    return out_words, max_cnt
+    return send_start, seg_end - send_start
 
 
 def radix_sort_spmd(
@@ -135,18 +138,73 @@ def radix_sort_spmd(
     reported value is a lower bound; the host loop grows the cap
     monotonically until no pass overflows).
     """
+    n = words[0].shape[0]
+    n_bins = 1 << digit_bits
+    my = lax.axis_index(axis)
     per_word = (32 + digit_bits - 1) // digit_bits
     total = per_word * n_words if passes is None else passes
     max_cnt = jnp.zeros((), jnp.int32)
-    done = 0
-    for w_idx in range(n_words - 1, -1, -1):          # lsw first
+
+    plan = []  # (word_idx, shift), lsw first
+    for w_idx in range(n_words - 1, -1, -1):
         for p in range(per_word):
-            if done >= total:
-                break
-            words, mc = _one_pass(
-                words, w_idx, p * digit_bits, digit_bits, n_ranks, cap, axis,
-                pack=pack,
+            if len(plan) < total:
+                plan.append((w_idx, p * digit_bits))
+
+    if not plan:
+        return words, max_cnt
+
+    # recv-buffer state between exchanges; None before the first pass.
+    recv: Words | None = None
+    recv_cnt = None
+    prev = None  # (H, digit_base, rank_base) of the pending exchange
+
+    for w_idx, shift in plan:
+        if recv is None:
+            # First pass: the flat shard is trivially "merged"; one
+            # stable 1-key sort groups by digit (stability = position
+            # order, exactly the (digit, slot) key of later passes).
+            d = kernels.digit_at(words[w_idx], shift, digit_bits)
+            ops = lax.sort([d] + list(words), num_keys=1, is_stable=True)
+            sd, sorted_words = ops[0], tuple(ops[1:])
+        else:
+            # Fused pass: merge the pending exchange buffer AND group by
+            # the new digit with ONE sort keyed on (digit, slot) — the
+            # pair is unique per valid lane, so no stability needed.
+            slot = _lane_slots(recv_cnt, *prev, n, cap, axis)
+            d = kernels.digit_at(recv[w_idx], shift, digit_bits)
+            c = lax.iota(jnp.int32, cap)[None, :]
+            d = jnp.where(c < recv_cnt[:, None], d, n_bins)
+            ops = lax.sort(
+                [d.reshape(-1), slot.reshape(-1)] + [r.reshape(-1) for r in recv],
+                num_keys=2, is_stable=False,
             )
-            max_cnt = jnp.maximum(max_cnt, mc)
-            done += 1
-    return words, max_cnt
+            # Valid lanes total exactly n and sort to the front (invalid
+            # carry the n_bins sentinel digit).
+            sd = ops[0][:n]
+            sorted_words = tuple(o[:n] for o in ops[2:])
+
+        # Histogram + first-occurrence offsets from the sorted digits.
+        h, lo_local = kernels.histogram_sorted(sd, n_bins)
+        H, tot, rank_base = coll.exscan_counts(h, axis)
+        digit_base = coll.exclusive_cumsum(tot)
+        base = digit_base + rank_base[my]          # [bins] my global run starts
+
+        # dest[j] = base[sd[j]] + (j - lo[sd[j]]) — gather-free step fn.
+        dest = kernels.piecewise_fill(lo_local, base - lo_local, n) + lax.iota(jnp.int32, n)
+        send_start, send_cnt = _send_segments(dest, n, n_ranks)
+
+        recv, recv_cnt, mc = coll.ragged_all_to_all(
+            sorted_words, send_start, send_cnt, cap, n_ranks, axis, pack=pack
+        )
+        max_cnt = jnp.maximum(max_cnt, mc)
+        prev = (H, digit_base, rank_base)
+
+    # Materialize the last pass's pending merge: one 1-key sort on slot.
+    slot = _lane_slots(recv_cnt, *prev, n, cap, axis)
+    flat = lax.sort(
+        [slot.reshape(-1)] + [r.reshape(-1) for r in recv],
+        num_keys=1, is_stable=False,
+    )
+    out_words = tuple(o[:n] for o in flat[1:])
+    return out_words, max_cnt

@@ -15,10 +15,19 @@ hence the row-aligned loads and the in-register shift instead of an
 arbitrary-offset DMA; 1-D vector ops are unsupported — hence everything
 is [rows, 128].
 
-The local sorts stay on ``lax.sort`` deliberately: XLA's fused sorting
-network is already near memory-bound for 32-bit keys, and a Pallas radix
-sort would need cross-tile scatters — the exact primitive the hardware
-lacks.
+The local sorts stay on ``lax.sort`` — a measured trade-off, NOT a
+memory-bound claim (round 1 asserted "near memory-bound" here; the
+arithmetic refutes it — see BASELINE.md "Roofline analysis", which puts
+``lax.sort`` at 2^26 roughly 250× the 2-pass HBM bound, as expected of
+an O(n log² n) comparison network).  It survives because every measured
+alternative is worse on this hardware: XLA scatter/gather permutations
+run 3-6× slower than the sort they would replace, batched row sorts
+only get cheap below rows of 2^14 while bucketing into rows that small
+forces padding blowup and a second sort that eats the gain, and a
+Mosaic radix scatter would need per-element cross-tile addressing — the
+primitive the VPU lacks.  The realistic escalation path is a fused
+in-VMEM bitonic/column-sort kernel (future work, tracked in
+BASELINE.md), not a radix scatter.
 """
 
 from __future__ import annotations

@@ -1,0 +1,391 @@
+"""Pallas bitonic sort — the single-chip local sort engine.
+
+Replaces ``lax.sort`` for large one-word (uint32) shards.  XLA's TPU sort
+lowers to a comparison network whose per-layer cost is dominated by
+generic lowering overhead: measured 84.6 ms for 2^26 uint32 on v5e (see
+BASELINE.md "kernel design study"), ~30x the two-pass HBM streaming
+floor.  This kernel implements the same O(n log^2 n) bitonic network
+with every data movement expressed as *static* circular shifts
+(``pltpu.roll``) — the partner of element ``i`` at distance ``d = 2^j``
+is ``i ^ d``, reachable by two rolls and one select — so the whole
+network compiles to dense VPU code with no data-dependent addressing,
+which the TPU does not have (no vectorized gather/scatter; the roofline
+study in BASELINE.md prices every alternative).
+
+Design (tpu-first, not a port of any CPU/GPU radix scheme):
+
+- The array lives as ``[nblk, S, 128]`` (row-major flat order), block =
+  ``S*128 = 2^B`` elements sized to VMEM (~1 MiB for B=18).
+- One **standard bitonic network over the whole padded array**; layers
+  are partitioned by compare distance into three kernels:
+
+  * ``block-sort``: all stages with size <= 2^B, unrolled in-VMEM per
+    block (grid over blocks, one HBM round-trip total).  Directions come
+    from the *global* flat index, so block b ends sorted ascending /
+    descending by the parity the merge stages expect.
+  * ``cross``: one layer at distance >= 2^B — pure elementwise min/max
+    between block pairs ``b`` and ``b ^ D``; the take-min side is
+    constant per block (bit of the block index), so there are no
+    per-element masks at all.
+  * ``intra``: for each merge stage, the trailing B layers (distance
+    < 2^B) fused into one in-VMEM sweep per block.
+
+- Compare distances, stage numbers and pair strides ride in as
+  scalar-prefetch operands (``PrefetchScalarGridSpec``), so each kernel
+  compiles **once** per array shape, not once per layer.
+
+The network is oblivious (layer sequence depends only on N), so output
+is deterministic and bit-identical run to run — the same canonical
+sorted bytes ``lax.sort`` or ``qsort`` would produce (reference output
+contract: ``mpi_sample_sort.c:203-205``).
+
+Scope: one-word uint32 keys (the encoded form of int32/uint32 — see
+``ops/keys.py``), key-only (no payload): exactly the flagship
+single-device path.  Multi-word keys and the SPMD per-pass sorts keep
+``lax.sort`` (see ``kernels.local_sort``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128
+LANES_LOG2 = 7
+#: log2 of elements per block: S = 2^(B-7) sublanes x 128 lanes = 256 KiB
+#: u32.  2^18 (the VMEM-optimal choice on paper) OOMs scoped VMEM: Mosaic
+#: keeps ~34 copies of the block live across the unrolled 100+-layer chain.
+BLOCK_LOG2 = 16
+#: below this the padded network does not beat lax.sort's fixed costs.
+MIN_SORT_LOG2 = 13
+#: blocks per cross-layer transfer group (see ``_cross_kernel``).
+_CROSS_GROUP = 8
+
+
+def _asc_layer(x, lj: int, t_layout: bool = False):
+    """Ascending compare-exchange at distance ``2^lj`` — 6 vector ops.
+
+    The partner of element ``i`` is ``i ^ 2^lj``.  Low-side elements
+    (bit ``lj`` clear) keep ``min(x, x[i+d])``, high-side keep
+    ``max(x, x[i-d])`` — no separate partner select, no direction mask:
+    *every* segment compares ascending because the callers bit-flip the
+    values of descending segments up front (``~x`` reverses int32
+    order), which is what makes the per-layer cost 2 rolls + min + max
+    + mask + select instead of the 12-op direct form.
+
+    Layout: in the natural ``[S, 128]`` block, ``lj >= 7`` distances
+    are *sublane* rolls and ``lj < 7`` would be lane rolls — which cost
+    ~15x a sublane roll on v5e (measured; the cross-lane shift network
+    is the scarce resource).  Callers therefore run all ``lj < 7``
+    layers on the transposed ``[128, S]`` block (``t_layout=True``),
+    where the original lane index is the sublane axis and the same
+    distances become sublane rolls; two [S,128] transposes per section
+    amortize over seven avoided lane-roll layers.  Both rolls are
+    cyclic, but segments of ``2^(lj+1)`` tile the axis exactly, so the
+    selected half never reads a wrapped value.
+    """
+    if t_layout:
+        assert lj < LANES_LOG2
+        axis, shift, log = 0, 1 << lj, lj
+    elif lj < LANES_LOG2:
+        axis, shift, log = 1, 1 << lj, lj
+    else:
+        axis, shift, log = 0, 1 << (lj - LANES_LOG2), lj - LANES_LOG2
+    size = x.shape[axis]
+    fwd = pltpu.roll(x, size - shift, axis)  # out[i] = in[i + shift]
+    bwd = pltpu.roll(x, shift, axis)         # out[i] = in[i - shift]
+    idx = lax.broadcasted_iota(jnp.int32, x.shape, axis)
+    low = ((idx >> log) & 1) == 0            # bit clear -> partner above
+    return jnp.where(low, jnp.minimum(x, fwd), jnp.maximum(x, bwd))
+
+
+def _sweep(x, b_log2: int):
+    """The trailing in-block sweep: layers ``B-1 .. 0`` ascending, with
+    the ``lj < 7`` tail run on the transposed block (see
+    :func:`_asc_layer` on why lane rolls are banned)."""
+    for lj in range(b_log2 - 1, LANES_LOG2 - 1, -1):
+        x = _asc_layer(x, lj)
+    xt = x.T
+    for lj in range(LANES_LOG2 - 1, -1, -1):
+        xt = _asc_layer(xt, lj, t_layout=True)
+    return xt.T
+
+
+def _flat_bit(shape, j: int, t_layout: bool):
+    """Mask ``bit_j(flat index) == 1`` for a block in either layout.
+
+    flat = r*128 + l; natural layout is [r=S sublanes, l=128 lanes],
+    transposed is [l, r]: bit j < 7 lives on the lane index, the rest
+    on the row index."""
+    if j < LANES_LOG2:
+        axis = 0 if t_layout else 1
+        bit = j
+    else:
+        axis = 1 if t_layout else 0
+        bit = j - LANES_LOG2
+    idx = lax.broadcasted_iota(jnp.int32, shape, axis)
+    return ((idx >> bit) & 1) == 1
+
+
+# ---------------------------------------------------------------- kernels
+
+
+def _block_sort_kernel(x_ref, o_ref, *, s_rows: int, b_log2: int):
+    """Stages 1..B of the network, in-VMEM, one block per grid step.
+
+    Flip-state bookkeeping: before stage ``m`` runs, values in its
+    descending segments (bit ``m`` of the flat index set) are held
+    bit-flipped, so every layer is the cheap ascending form.  Between
+    stages only the *difference* of the two masks re-flips (one xor-mask
+    pass per stage vs a direction select per layer); all masks here
+    depend on the local index only — block-independent — except the
+    final unflip, whose mask degenerates to the block parity.
+    """
+    blk = pl.program_id(0)
+    x = x_ref[0]
+
+    def transition(x, m, t_layout):
+        """Re-flip from stage ``m``'s direction mask to stage ``m+1``'s
+        (or unflip after the last stage); masks are local-index bits
+        except bit B, which is the block parity."""
+        delta = _flat_bit(x.shape, m, t_layout)
+        if m + 1 < b_log2:
+            delta = delta ^ _flat_bit(x.shape, m + 1, t_layout)
+        elif m + 1 == b_log2:
+            delta = delta ^ ((blk & 1) == 1)
+        else:  # after the final stage: unflip from the parity state
+            delta = (blk & 1) == 1
+            return jnp.where(delta, ~x, x)
+        return jnp.where(delta, ~x, x)
+
+    # Stages 1..7 run wholly on the transposed block: every layer there
+    # has lane-sized distance, and lane rolls are what we must avoid.
+    xt = x.T
+    xt = jnp.where(_flat_bit(xt.shape, 1, True), ~xt, xt)
+    for m in range(1, LANES_LOG2 + 1):
+        for lj in range(m - 1, -1, -1):
+            xt = _asc_layer(xt, lj, t_layout=True)
+        xt = transition(xt, m, True)
+    x = xt.T
+    for m in range(LANES_LOG2 + 1, b_log2 + 1):
+        for lj in range(m - 1, LANES_LOG2 - 1, -1):
+            x = _asc_layer(x, lj)
+        xt = x.T
+        for lj in range(LANES_LOG2 - 1, -1, -1):
+            xt = _asc_layer(xt, lj, t_layout=True)
+        x = xt.T
+        x = transition(x, m, False)
+    o_ref[0] = x
+
+
+def _cross_kernel(s_ref, xl_ref, xh_ref, o_ref):
+    """One distance >= 2^(B+3) layer, one output *group* per grid step.
+
+    The transfer unit is a contiguous group of ``_CROSS_GROUP`` blocks:
+    every cross layer handled here has block distance >= 8 (the lowest
+    three cross bits belong to the merge kernel), so partner blocks
+    have equal low-3 bits and whole groups pair with whole groups —
+    the same XOR pairing lifted to group indices, with ~2 MiB DMAs
+    instead of 256 KiB ones.
+
+    Scalar prefetch ``s_ref = [sjg, sm]``: the layer's distance in
+    *group-index bits* (``sjg = lj - B - 3``) and stage size in
+    block-index bits (``sm = lk - B``).  Grid is ``(group_pairs, 2)``:
+    step ``(q, r)`` reads both groups of pair ``q`` and writes only the
+    ``r``-side one, so one output array receives every group with no
+    reconciliation pass (the pair's min/max is computed twice — three
+    VPU ops against an HBM-bound layer).  The take-min side is a bit of
+    the group id (``sm >= 4`` exceeds the in-group bits): no
+    per-element masks at all.
+    """
+    sjg, sm = s_ref[0], s_ref[1]
+    q = pl.program_id(0)
+    r = pl.program_id(1)
+    mask = (1 << sjg) - 1
+    glo = ((q & ~mask) << 1) | (q & mask)
+    blo = glo * _CROSS_GROUP  # any block of the low group: shared high bits
+    take_min_low = ((blo >> sm) & 1) == 0
+    lo = jnp.minimum(xl_ref[:], xh_ref[:])
+    hi = jnp.maximum(xl_ref[:], xh_ref[:])
+    o_ref[:] = jnp.where(take_min_low ^ (r == 1), lo, hi)
+
+
+def _merge_kernel(s_ref, x_ref, o_ref, *, n_members: int, s_rows: int,
+                  b_log2: int):
+    """A stage's trailing chunk: the ``c = log2(G)`` lowest cross layers
+    AND the whole in-block sweep, in ONE visit of each block to VMEM.
+
+    Grid step ``g`` owns the *contiguous* member group ``{g*G + i}`` —
+    the XOR-neighborhood of the cross layers at block-bit positions
+    ``c-1 .. 0``: member ``i`` pairs with ``i ^ 2^k``, a Python-level
+    slice pairing with no data movement.  Cross compare directions are
+    scalar per member (a block-id bit), so a fused cross layer costs
+    three vector ops per element; the fusion is what turns the merge
+    tail from one HBM round-trip per layer into one per stage.
+
+    Scalar ``s_ref = [m]``: the stage number, for compare directions.
+    """
+    m = s_ref[0]
+    g = pl.program_id(0)
+    sign_shift = m - b_log2
+    bids = [g * n_members + i for i in range(n_members)]
+    # Stage direction is a block-id bit — one scalar flip per member
+    # makes every fused layer the raw ascending form.
+    desc = [((bid >> sign_shift) & 1) == 1 for bid in bids]
+    xs = [jnp.where(desc[i], ~x_ref[i], x_ref[i]) for i in range(n_members)]
+
+    c = n_members.bit_length() - 1
+    for k in range(c - 1, -1, -1):
+        for i in range(n_members):
+            if (i >> k) & 1:
+                continue
+            j = i | (1 << k)
+            # Members of a pair share the stage-direction bit (they
+            # differ only in bit k < sign_shift), so flipped ascending
+            # min/max is exact — two vector ops, no selects.
+            lo = jnp.minimum(xs[i], xs[j])
+            hi = jnp.maximum(xs[i], xs[j])
+            xs[i], xs[j] = lo, hi
+
+    for i in range(n_members):
+        x = _sweep(xs[i], b_log2)
+        o_ref[i] = jnp.where(desc[i], ~x, x)
+
+
+# ----------------------------------------------------------- host drivers
+
+
+@functools.lru_cache(maxsize=16)
+def _compile_block_sort(nblk: int, s_rows: int, b_log2: int, interpret: bool):
+    spec = pl.BlockSpec((1, s_rows, LANES), lambda i: (i, 0, 0),
+                        memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        functools.partial(_block_sort_kernel, s_rows=s_rows, b_log2=b_log2),
+        out_shape=jax.ShapeDtypeStruct((nblk, s_rows, LANES), jnp.int32),
+        grid=(nblk,),
+        in_specs=[spec],
+        out_specs=spec,
+        input_output_aliases={0: 0},
+        interpret=interpret,
+    )
+
+
+@functools.lru_cache(maxsize=16)
+def _compile_cross(nblk: int, s_rows: int, interpret: bool):
+    """One call exchanges all ``nblk/2`` pairs at block distance ``2^sj``.
+
+    The pair layout rides in through the index maps, which receive the
+    scalar-prefetch ref: grid step ``(p, r)`` loads blocks ``bl`` (bit
+    ``sj`` clear) and ``bl | 2^sj`` and writes the ``r``-side one.  One
+    compilation serves every distance.
+    """
+    def pair_map(side):
+        def f(q, r, s_ref):
+            sjg = s_ref[0]
+            mask = (1 << sjg) - 1
+            glo = ((q & ~mask) << 1) | (q & mask)
+            pick = side if side is not None else r
+            return (glo | (pick << sjg), 0, 0)
+        return f
+
+    ngroups = nblk // _CROSS_GROUP
+    gspec = lambda m: pl.BlockSpec((_CROSS_GROUP, s_rows, LANES), m,
+                                   memory_space=pltpu.VMEM)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(ngroups // 2, 2),
+        in_specs=[gspec(pair_map(0)), gspec(pair_map(1))],
+        out_specs=gspec(pair_map(None)),
+    )
+    return pl.pallas_call(
+        _cross_kernel,
+        out_shape=jax.ShapeDtypeStruct((nblk, s_rows, LANES), jnp.int32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )
+
+
+@functools.lru_cache(maxsize=16)
+def _compile_merge(n_members: int, nblk: int, s_rows: int, b_log2: int,
+                   interpret: bool):
+    spec = pl.BlockSpec((n_members, s_rows, LANES), lambda g, s: (g, 0, 0),
+                        memory_space=pltpu.VMEM)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nblk // n_members,),
+        in_specs=[spec],
+        out_specs=spec,
+    )
+    return pl.pallas_call(
+        functools.partial(_merge_kernel, n_members=n_members, s_rows=s_rows,
+                          b_log2=b_log2),
+        out_shape=jax.ShapeDtypeStruct((nblk, s_rows, LANES), jnp.int32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )
+
+
+def sort_padded(x, n_pow2: int, b_log2: int, interpret: bool = False):
+    """Bitonic-sort a padded power-of-two uint32 array of ``n_pow2``.
+
+    ``x``: flat uint32 [n_pow2], ``n_pow2 = 2^t``, ``t >= b_log2 >= 10``.
+    Returns the sorted flat array.  Pure function of shapes — jittable.
+
+    The network itself runs in the *int32* domain (Mosaic has no
+    unsigned vector min/max): the sign bit is flipped on the way in and
+    out — an order-preserving bijection uint32 -> int32, two cheap
+    elementwise passes against ~100 network layers.
+    """
+    t = n_pow2.bit_length() - 1
+    assert 1 << t == n_pow2 and t >= b_log2
+    s_rows = 1 << (b_log2 - LANES_LOG2)
+    nblk = n_pow2 >> b_log2
+    x = lax.bitcast_convert_type(x ^ jnp.uint32(0x80000000), jnp.int32)
+    xb = x.reshape(nblk, s_rows, LANES)
+
+    xb = _compile_block_sort(nblk, s_rows, b_log2, interpret)(xb)
+
+    cross = _compile_cross(nblk, s_rows, interpret) if t > b_log2 + 3 else None
+
+    for m in range(b_log2 + 1, t + 1):
+        nbits = m - b_log2  # cross layers at block-bit positions nbits-1..0
+        # High cross layers (block distance >= 8) one at a time; the
+        # lowest min(nbits, 3) fuse into the merge kernel with the sweep.
+        for sj in range(nbits - 1, 2, -1):
+            xb = cross(jnp.asarray([sj - 3, nbits], jnp.int32), xb, xb)
+        g_final = 1 << min(nbits, 3)
+        merge = _compile_merge(g_final, nblk, s_rows, b_log2, interpret)
+        xb = merge(jnp.asarray([m], jnp.int32), xb)
+    out = xb.reshape(-1)
+    return lax.bitcast_convert_type(out, jnp.uint32) ^ jnp.uint32(0x80000000)
+
+
+def bitonic_sort_u32(x, interpret: bool = False):
+    """Sort a flat uint32 array ascending; drop-in for ``jnp.sort``.
+
+    Pads to the next power of two with the max sentinel (pads sort to
+    the tail and are sliced off — same contract as the API layer's
+    pad-with-max, ``models/api.py``).  Arrays smaller than
+    ``2^MIN_SORT_LOG2`` fall back to ``lax.sort`` — below that size the
+    network's fixed padding/pass structure costs more than it saves.
+    """
+    n = x.shape[0]
+    if n == 0:
+        return x
+    if n < (1 << MIN_SORT_LOG2):
+        return lax.sort([x], num_keys=1, is_stable=False)[0]
+    t = max((n - 1).bit_length(), MIN_SORT_LOG2)
+    b_log2 = min(BLOCK_LOG2, t)
+    n_pow2 = 1 << t
+    if n_pow2 != n:
+        pad = jnp.full((n_pow2 - n,), jnp.uint32(0xFFFFFFFF), jnp.uint32)
+        xp = jnp.concatenate([x, pad])
+    else:
+        xp = x
+    out = sort_padded(xp, n_pow2, b_log2, interpret=interpret)
+    return out[:n] if n_pow2 != n else out

@@ -65,7 +65,8 @@ def main(argv: list[str] | None = None) -> int:
     tracer = Tracer(level=debug)
     algo = os.environ.get("SORT_ALGO", "sample")
     dtype = np.dtype(os.environ.get("SORT_DTYPE", "int32"))
-    digit_bits = int(os.environ.get("SORT_DIGIT_BITS", "8"))
+    db_env = os.environ.get("SORT_DIGIT_BITS", "auto")
+    digit_bits = None if db_env == "auto" else int(db_env)
     ranks = os.environ.get("SORT_RANKS")
 
     try:

@@ -348,7 +348,7 @@ def sort(
     x,
     algorithm: str = "radix",
     mesh: Mesh | None = None,
-    digit_bits: int = 8,
+    digit_bits: int | None = None,
     cap_factor: float = 2.0,
     oversample: int | None = None,
     tracer: Tracer | None = None,
@@ -505,11 +505,21 @@ def sort(
                 # plans the pass count (pads replicate the max key — range
                 # unchanged).
                 ranges = _compile_word_range(dtype.name)(x.reshape(-1))
-                passes = _passes_from_diffs(
-                    tuple(int(lo) ^ int(hi) for lo, hi in ranges), digit_bits
-                )
+                diffs = tuple(int(lo) ^ int(hi) for lo, hi in ranges)
             else:
-                passes = _needed_passes(words_np, digit_bits)
+                diffs = tuple(int(w.max()) ^ int(w.min()) for w in words_np)
+            if digit_bits is None:
+                # Auto width: a pass costs one full fused sort regardless
+                # of digit width (BASELINE.md roofline), so wider digits
+                # that cut the pass count win outright; 16-bit digits
+                # halve full-range int32 to 2 passes.  The histogram /
+                # exscan metadata grows to [P, 65536] int32 — 256 KiB per
+                # device per pass, noise next to the shard itself.
+                digit_bits = (
+                    16 if _passes_from_diffs(diffs, 16) < _passes_from_diffs(diffs, 8)
+                    else 8
+                )
+            passes = _passes_from_diffs(diffs, digit_bits)
         while True:
             fn = _compile_radix(mesh, codec.n_words, n, digit_bits, cap, passes,
                                 pack_impl)
@@ -532,6 +542,7 @@ def sort(
             cap = _round_cap(max_cnt, align)
         tracer.count("exchange_passes", passes)
         tracer.counters["exchange_cap"] = cap  # last cap, not accumulated
+        tracer.counters["digit_bits"] = digit_bits  # auto-resolved width
         res = DistributedSortResult(out, N, dtype)
     assert res is not None
 

@@ -190,3 +190,31 @@ def test_median_probe(mesh8, rng):
     for algo in ALGOS:
         res = sort(x, algorithm=algo, mesh=mesh8, return_result=True)
         assert res.median_probe() == ref
+
+
+def test_auto_digit_width(mesh8, rng):
+    """Full-range int32 auto-plans 16-bit digits -> 2 passes; a narrow
+    range still collapses to one cheap 8-bit pass (pass count is what a
+    pass costs a full fused sort for — BASELINE.md roofline)."""
+    from mpitest_tpu.utils.trace import Tracer
+
+    x = rng.integers(-(2**31), 2**31 - 1, size=10_000, dtype=np.int32)
+    tr = Tracer()
+    got = sort(x, algorithm="radix", mesh=mesh8, tracer=tr)
+    np.testing.assert_array_equal(got, np.sort(x))
+    assert tr.counters["digit_bits"] == 16
+    assert tr.counters["exchange_passes"] == 2
+
+    narrow = rng.integers(0, 200, size=10_000, dtype=np.int32)
+    tr2 = Tracer()
+    got2 = sort(narrow, algorithm="radix", mesh=mesh8, tracer=tr2)
+    np.testing.assert_array_equal(got2, np.sort(narrow))
+    assert tr2.counters["digit_bits"] == 8
+    assert tr2.counters["exchange_passes"] == 1
+
+
+def test_explicit_digit_bits_still_work(mesh8, rng):
+    x = rng.integers(-(2**31), 2**31 - 1, size=5_000, dtype=np.int32)
+    for db in (4, 8, 11, 16):
+        got = sort(x, algorithm="radix", mesh=mesh8, digit_bits=db)
+        np.testing.assert_array_equal(got, np.sort(x))

@@ -183,3 +183,36 @@ def test_native_vs_tpu_golden_parity(binaries, tmp_path, rng):
         ]
         native_out = np.array(dump, np.uint32).view(np.int32)
         assert native_out.tobytes() == tpu_out.tobytes()
+
+
+def test_thread_sanitizer_race_check(tmp_path, rng):
+    """The pthreads comm backend must be race-clean under TSan — the
+    executable race check SURVEY.md §5 prescribes (`make SANITIZE=thread`;
+    the reference's hand-rolled collectives carry real races: unwaited
+    Isends reusing one request, mpi_sample_sort.c:37,63).  Builds into a
+    scratch copy of nothing — the per-backend stamp includes the
+    sanitize value, so this build cannot poison the plain binaries."""
+    if shutil.which("cc") is None and shutil.which("gcc") is None:
+        pytest.skip("no C compiler")
+    probe = subprocess.run(
+        ["cc", "-fsanitize=thread", "-x", "c", "-", "-o", str(tmp_path / "p")],
+        input="int main(void){return 0;}", capture_output=True, text=True,
+    )
+    if probe.returncode != 0:
+        pytest.skip("toolchain lacks -fsanitize=thread runtime")
+    keys = rng.integers(-(2**31), 2**31 - 1, size=20_000, dtype=np.int32)
+    path = write_keys(tmp_path, keys)
+    for d, binary in (("mpi_sample_sort", "sample_sort"),
+                      ("mpi_radix_sort", "radix_sort")):
+        r = subprocess.run(
+            ["make", "-C", str(REPO / d), "BACKEND=local", "SANITIZE=thread"],
+            capture_output=True, text=True,
+        )
+        assert r.returncode == 0, r.stderr
+        run = run_native(str(REPO / d / binary), path, ranks=8,
+                         env={"TSAN_OPTIONS": "exitcode=66 halt_on_error=1"})
+        assert run.returncode == 0, (run.returncode, run.stderr[-2000:])
+        assert "WARNING: ThreadSanitizer" not in run.stderr
+        # restore the plain binary so later tests don't run under TSan
+        subprocess.run(["make", "-C", str(REPO / d), "BACKEND=local"],
+                       capture_output=True, text=True)

@@ -1,0 +1,142 @@
+#!/usr/bin/env python3
+"""Primitive-cost probes behind the BASELINE.md kernel design study.
+
+Measures, on the real chip, the per-call device time of the primitives
+that bound any Pallas sort kernel on this hardware: streaming copy
+(bandwidth floor), elementwise VPU ops, sublane vs lane rolls (the 15x
+asymmetry that shaped ``ops/bitonic.py``), block transpose, `lax.sort`,
+and the bitonic engine itself.
+
+Method: slope of chained in-jit calls between two rep counts, with a
+forced scalar ``device_get`` after each timed call —
+``block_until_ready`` is advisory over this image's tunnel, and the
+~0.1-0.2 s fixed dispatch cost swamps single-call timings (the round-1
+numbers in the table at the top of BASELINE.md suffered exactly that).
+
+Usage: python bench/kernel_probes.py [--log2n 26]
+Emits one metrics-sidecar JSON line per probe on stderr and a summary
+table on stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--log2n", type=int, default=26)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from mpitest_tpu.ops import bitonic
+    from mpitest_tpu.utils.metrics import Metrics
+
+    n = 1 << args.log2n
+    s_rows, lanes = 512, 128
+    nblk = n // (s_rows * lanes)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(
+        rng.integers(-(2**31), 2**31, n, dtype=np.int32)
+    ).reshape(nblk, s_rows, lanes)
+
+    spec = pl.BlockSpec((1, s_rows, lanes), lambda i: (i, 0, 0),
+                        memory_space=pltpu.VMEM)
+
+    def kernel_call(body, k_reps):
+        def kern(x_ref, o_ref):
+            v = x_ref[0]
+            for k in range(k_reps):
+                v = body(v, k)
+            o_ref[0] = v
+        return pl.pallas_call(
+            kern,
+            out_shape=jax.ShapeDtypeStruct((nblk, s_rows, lanes), jnp.int32),
+            grid=(nblk,), in_specs=[spec], out_specs=spec,
+        )
+
+    def slope(fn, reps=(1, 9), tries=3):
+        out = {}
+        for r in reps:
+            @jax.jit
+            def g(v, r=r):
+                for _ in range(r):
+                    v = fn(v)
+                return v
+            y = g(x)
+            jax.device_get(y.reshape(-1)[:1])
+            ts = []
+            for _ in range(tries):
+                t0 = time.perf_counter()
+                y = g(x)
+                jax.device_get(y.reshape(-1)[:1])
+                ts.append(time.perf_counter() - t0)
+            out[r] = min(ts)
+        return (out[reps[1]] - out[reps[0]]) / (reps[1] - reps[0])
+
+    K = 32
+    probes = [
+        ("copy_pass", kernel_call(lambda v, k: v + 1, 1), 1),
+        ("vpu_add", kernel_call(lambda v, k: v + k, K), K),
+        ("vpu_min_mul_add", kernel_call(lambda v, k: jnp.minimum(v, v * 2 + k), K), K),
+        ("sublane_roll", kernel_call(lambda v, k: pltpu.roll(v, 1 << (k % 6), 0), K), K),
+        ("lane_roll", kernel_call(lambda v, k: pltpu.roll(v, 1 << (k % 6), 1), K), K),
+        ("transpose_pair",
+         kernel_call(lambda v, k: pltpu.roll(v.T, 1, 0).T, K), K),
+    ]
+
+    metrics = Metrics(config={"probe": "kernel_primitives",
+                              "log2n": args.log2n})
+    print(f"{'probe':22s} {'ms/unit':>10s}")
+    for name, call, units in probes:
+        per = slope(lambda v, c=call: c(v)) / units
+        metrics.record(f"{name}_ms", round(per * 1e3, 4), "ms")
+        print(f"{name:22s} {per*1e3:10.4f}")
+
+    flat = x.reshape(-1)
+    def slope_flat(fn, reps=(1, 3)):
+        out = {}
+        for r in reps:
+            @jax.jit
+            def g(v, r=r):
+                for _ in range(r):
+                    v = fn(v)
+                return v
+            y = g(flat)
+            jax.device_get(y[:1])
+            ts = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                y = g(flat)
+                jax.device_get(y[:1])
+                ts.append(time.perf_counter() - t0)
+            out[r] = min(ts)
+        return (out[reps[1]] - out[reps[0]]) / (reps[1] - reps[0])
+
+    for name, fn in [
+        ("lax_sort", lambda v: jax.lax.sort([v], num_keys=1, is_stable=False)[0]),
+        ("pallas_bitonic", lambda v: jax.lax.bitcast_convert_type(
+            bitonic.sort_padded(
+                jax.lax.bitcast_convert_type(v, jnp.uint32), n,
+                bitonic.BLOCK_LOG2),
+            jnp.int32)),
+    ]:
+        per = slope_flat(fn)
+        metrics.record(f"{name}_ms", round(per * 1e3, 2), "ms")
+        print(f"{name:22s} {per*1e3:10.2f}")
+
+    metrics.dump()
+
+
+if __name__ == "__main__":
+    main()

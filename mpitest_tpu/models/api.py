@@ -282,12 +282,12 @@ def _compile_radix(mesh: Mesh, n_words: int, n: int, digit_bits: int, cap: int,
 
 @lru_cache(maxsize=64)
 def _compile_sample(mesh: Mesh, n_words: int, n: int, cap: int, oversample: int,
-                    pack: str):
+                    pack: str, engine: str = "lax"):
     n_ranks = mesh.devices.size
 
     def f(*words):
         out, count, max_cnt = sample_sort.sample_sort_spmd(
-            words, n_words, n_ranks, cap, oversample, pack=pack
+            words, n_words, n_ranks, cap, oversample, pack=pack, engine=engine
         )
         return out, count[None], max_cnt
 
@@ -297,7 +297,9 @@ def _compile_sample(mesh: Mesh, n_words: int, n: int, cap: int, oversample: int,
             mesh=mesh,
             in_specs=(P(AXIS),) * n_words,
             out_specs=((P(AXIS),) * n_words, P(AXIS), P()),
-            check_vma=(pack == "xla"),
+            # pallas_call internals (exchange pack, bitonic engine) mix
+            # varying/unvarying operands in ways the vma checker rejects.
+            check_vma=(pack == "xla" and engine == "lax"),
         )
     )
 
@@ -464,9 +466,12 @@ def sort(
             cap_limit = _round_cap(
                 SAMPLE_CAP_LIMIT_FACTOR * max(1, -(-n // n_ranks)), align
             )
+            spmd_engine = ("bitonic" if _use_bitonic(_local_engine(),
+                                                     codec.n_words, n)
+                           else "lax")
             while True:
                 fn = _compile_sample(mesh, codec.n_words, n, cap, oversample,
-                                     pack_impl)
+                                     pack_impl, spmd_engine)
                 with tracer.phase("sort"):
                     out, counts, max_cnt = fn(*words)
                     max_cnt = int(max_cnt)

@@ -66,14 +66,21 @@ def sample_sort_spmd(
     oversample: int,
     axis: str = AXIS,
     pack: str = "xla",
+    engine: str = "lax",
 ) -> tuple[Words, jax.Array, jax.Array]:
     """Full sample sort of the shard. SPMD; call under shard_map.
 
     Returns ``(out_words, count, max_send_cnt)`` where ``out_words`` are
     [P*cap] per-device buffers whose first ``count`` slots are the valid
     globally-sorted run for this shard position.
+
+    ``engine`` selects the per-shard sort for the two big local sorts
+    (the pre-split shard sort and the post-exchange merge): ``"bitonic"``
+    = the Pallas engine of ``ops/bitonic.py`` (one-word keys), ``"lax"``
+    = the fused ``lax.sort``.  The tiny splitter-sample sort always uses
+    ``lax.sort``.
     """
-    sorted_words = kernels.local_sort(words)
+    sorted_words = kernels.local_sort(words, engine=engine)
     splitters = select_splitters(sorted_words, n_ranks, oversample, axis)
 
     # dest[i] = number of splitters < key[i]  ∈ [0, P-1]; monotone since sorted.
@@ -93,6 +100,6 @@ def sample_sort_spmd(
     # first `count` slots after sorting are exactly the valid multiset
     # (canonical-output argument, SURVEY.md §7.3).
     flat = tuple(r.reshape(-1) for r in recv)
-    out = kernels.local_sort(flat)
+    out = kernels.local_sort(flat, engine=engine)
     count = jnp.minimum(recv_cnt, cap).sum().astype(jnp.int32)
     return out, count, max_cnt

@@ -17,12 +17,24 @@ from jax import lax
 Words = tuple[jax.Array, ...]
 
 
-def local_sort(words: Words) -> Words:
+def local_sort(words: Words, engine: str = "lax") -> Words:
     """Lexicographic stable sort of multi-word keys (msw first).
 
     ``lax.sort`` with ``num_keys=len(words)`` compares word tuples
     lexicographically — this is how 64-bit keys sort without x64.
+
+    ``engine="bitonic"`` routes one-word keys through the Pallas bitonic
+    engine (``ops/bitonic.py``, 1.64x ``lax.sort`` at 2^28 on v5e) —
+    including under ``shard_map``, which is how the distributed sample
+    sort accelerates its per-shard sorts on real TPU meshes.  On CPU
+    backends the kernel runs in interpret mode (that is what the virtual
+    CPU-mesh tests exercise); multi-word keys always use ``lax.sort``.
     """
+    if engine == "bitonic" and len(words) == 1:
+        from mpitest_tpu.ops import bitonic  # local import: optional path
+
+        interpret = jax.default_backend() == "cpu"
+        return (bitonic.bitonic_sort_u32(words[0], interpret=interpret),)
     if len(words) == 1:
         return (jnp.sort(words[0]),)
     return tuple(lax.sort(list(words), num_keys=len(words), is_stable=True))

@@ -218,3 +218,18 @@ def test_explicit_digit_bits_still_work(mesh8, rng):
     for db in (4, 8, 11, 16):
         got = sort(x, algorithm="radix", mesh=mesh8, digit_bits=db)
         np.testing.assert_array_equal(got, np.sort(x))
+
+
+def test_sample_spmd_bitonic_engine(mesh8, rng, monkeypatch):
+    """The distributed sample sort with its per-shard sorts on the Pallas
+    bitonic engine (interpret mode on the CPU mesh) — the multi-chip
+    acceleration path — produces the same bytes as np.sort."""
+    from mpitest_tpu.ops import bitonic
+
+    monkeypatch.setenv("SORT_LOCAL_ENGINE", "bitonic")
+    # keep interpret-mode runtime sane: small blocks, no lax fallback
+    monkeypatch.setattr(bitonic, "MIN_SORT_LOG2", 8)
+    monkeypatch.setattr(bitonic, "BLOCK_LOG2", 9)
+    x = rng.integers(-(2**31), 2**31 - 1, size=4096, dtype=np.int32)
+    got = sort(x, algorithm="sample", mesh=mesh8)
+    np.testing.assert_array_equal(got, np.sort(x))

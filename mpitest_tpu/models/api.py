@@ -227,8 +227,14 @@ def _compile_encode_pad(dtype_name: str, total: int, mesh: Mesh | None):
             # Pad with the maximum real key in the *native* order (encode
             # is order-preserving, so its word tuple is lexicographically
             # max) — never a per-word max, which for multi-word keys could
-            # fabricate a key larger than any real one.
-            mx_words = codec.encode_jax(jnp.max(x)[None])
+            # fabricate a key larger than any real one.  Float codecs pad
+            # with the all-ones sentinel instead: jnp.max is NaN-poisoned
+            # and a NaN "max" need not be the totalOrder maximum.
+            if codec.sentinel_pad:
+                mx_words = tuple(jnp.full((1,), mw, jnp.uint32)
+                                 for mw in codec.max_sentinel())
+            else:
+                mx_words = codec.encode_jax(jnp.max(x)[None])
             words = tuple(
                 jnp.concatenate([w, jnp.broadcast_to(mw[0], (pad,))])
                 for w, mw in zip(words, mx_words)
@@ -397,6 +403,10 @@ def sort(
     n = max(1, math.ceil(N / n_ranks))
 
     if n_ranks == 1 and algorithm in ("radix", "sample"):
+        tracer.counters["local_engine"] = (
+            "bitonic" if _use_bitonic(_local_engine(), codec.n_words, N)
+            else "lax"
+        )
         if is_device:
             with tracer.phase("sort"):
                 out = _compile_local_device(dtype.name, _local_engine())(
@@ -438,8 +448,13 @@ def sort(
             if N < n_ranks * n:
                 # Pad slots replicate the *maximum real key* (encode is
                 # order-preserving, so encoding the host max yields the
-                # lexicographically-max word tuple).
-                pad = tuple(int(w[0]) for w in codec.encode(np.asarray([flat.max()], dtype)))
+                # lexicographically-max word tuple).  Float codecs use the
+                # all-ones sentinel: np.max is NaN-poisoned, and a NaN
+                # "max" need not be the totalOrder maximum.
+                if codec.sentinel_pad:
+                    pad = codec.max_sentinel()
+                else:
+                    pad = tuple(int(w[0]) for w in codec.encode(np.asarray([flat.max()], dtype)))
             else:
                 pad = None  # divisible N: no padding, skip the host max() scan
 
@@ -469,6 +484,7 @@ def sort(
             spmd_engine = ("bitonic" if _use_bitonic(_local_engine(),
                                                      codec.n_words, n)
                            else "lax")
+            tracer.counters["local_engine"] = spmd_engine
             while True:
                 fn = _compile_sample(mesh, codec.n_words, n, cap, oversample,
                                      pack_impl, spmd_engine)

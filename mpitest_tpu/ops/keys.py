@@ -37,10 +37,29 @@ MAX_WORD = 0xFFFFFFFF
 
 @dataclass(frozen=True)
 class KeyCodec:
-    """Encode/decode a host integer dtype to/from uint32 word tuples."""
+    """Encode/decode a host numeric dtype to/from uint32 word tuples.
+
+    Floats use the IEEE total-order flip (negative values: all bits
+    inverted; non-negative: sign bit set), a bit-preserving bijection, so
+    NaNs, infinities, -0.0 < +0.0 and NaN payloads all sort in
+    ``totalOrder`` and decode back to their exact input bits.  This is a
+    *documented divergence* from ``np.sort`` (which moves every NaN to
+    the tail and treats ±0.0 as equal); the sorted multiset of bit
+    patterns is identical.
+    """
 
     dtype: np.dtype
     n_words: int
+    #: pad with the all-ones sentinel instead of the max real key
+    #: (floats: np.max is NaN-poisoned and NaN payloads break max-key
+    #: padding; the sentinel is the totalOrder maximum by construction).
+    sentinel_pad: bool = False
+
+    def _split64(self, u: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        return (
+            (u >> np.uint64(32)).astype(np.uint32),
+            (u & np.uint64(0xFFFFFFFF)).astype(np.uint32),
+        )
 
     def encode(self, x: np.ndarray) -> tuple[np.ndarray, ...]:
         """Host array -> tuple of uint32 word arrays, most-significant first."""
@@ -49,17 +68,17 @@ class KeyCodec:
             return ((x.view(np.uint32) ^ _SIGN32),)
         if self.dtype == np.dtype(np.uint32):
             return (x.copy(),)
+        if self.dtype == np.dtype(np.float32):
+            u = x.view(np.uint32)
+            return (np.where(u & _SIGN32, ~u, u ^ _SIGN32),)
         if self.dtype == np.dtype(np.int64):
-            u = x.view(np.uint64) ^ np.uint64(0x8000000000000000)
-            return (
-                (u >> np.uint64(32)).astype(np.uint32),
-                (u & np.uint64(0xFFFFFFFF)).astype(np.uint32),
-            )
+            return self._split64(x.view(np.uint64) ^ np.uint64(0x8000000000000000))
         if self.dtype == np.dtype(np.uint64):
-            return (
-                (x >> np.uint64(32)).astype(np.uint32),
-                (x & np.uint64(0xFFFFFFFF)).astype(np.uint32),
-            )
+            return self._split64(x)
+        if self.dtype == np.dtype(np.float64):
+            u = x.view(np.uint64)
+            s = np.uint64(0x8000000000000000)
+            return self._split64(np.where(u & s, ~u, u ^ s))
         raise TypeError(f"unsupported key dtype: {self.dtype}")
 
     def decode(self, words: tuple[np.ndarray, ...]) -> np.ndarray:
@@ -71,9 +90,15 @@ class KeyCodec:
             return (words[0] ^ _SIGN32).view(np.int32)
         if self.dtype == np.dtype(np.uint32):
             return words[0].copy()
+        if self.dtype == np.dtype(np.float32):
+            e = words[0]
+            return np.where(e & _SIGN32, e ^ _SIGN32, ~e).view(np.float32)
         u = (words[0].astype(np.uint64) << np.uint64(32)) | words[1].astype(np.uint64)
         if self.dtype == np.dtype(np.int64):
             return (u ^ np.uint64(0x8000000000000000)).view(np.int64)
+        if self.dtype == np.dtype(np.float64):
+            s = np.uint64(0x8000000000000000)
+            return np.where(u & s, u ^ s, ~u).view(np.float64)
         return u  # uint64
 
     def encode_jax(self, x):
@@ -94,7 +119,12 @@ class KeyCodec:
             return (lax.bitcast_convert_type(x, jnp.uint32) ^ jnp.uint32(0x80000000),)
         if self.dtype == np.dtype(np.uint32):
             return (x,)
-        if self.dtype in (np.dtype(np.int64), np.dtype(np.uint64)):
+        if self.dtype == np.dtype(np.float32):
+            u = lax.bitcast_convert_type(x, jnp.uint32)
+            neg = (u & jnp.uint32(0x80000000)) != 0
+            return (jnp.where(neg, ~u, u ^ jnp.uint32(0x80000000)),)
+        if self.dtype in (np.dtype(np.int64), np.dtype(np.uint64),
+                          np.dtype(np.float64)):
             if x.dtype != self.dtype:
                 raise TypeError(
                     f"device array has dtype {x.dtype}, expected {self.dtype} "
@@ -104,6 +134,11 @@ class KeyCodec:
             lo, hi = w[..., 0], w[..., 1]
             if self.dtype == np.dtype(np.int64):
                 hi = hi ^ jnp.uint32(0x80000000)
+            elif self.dtype == np.dtype(np.float64):
+                neg = (hi & jnp.uint32(0x80000000)) != 0
+                hi2 = jnp.where(neg, ~hi, hi ^ jnp.uint32(0x80000000))
+                lo = jnp.where(neg, ~lo, lo)
+                hi = hi2
             return (hi, lo)
         raise TypeError(f"device-side encode unsupported for {self.dtype}")
 
@@ -118,6 +153,8 @@ _CODECS = {
     np.dtype(np.uint32): KeyCodec(np.dtype(np.uint32), 1),
     np.dtype(np.int64): KeyCodec(np.dtype(np.int64), 2),
     np.dtype(np.uint64): KeyCodec(np.dtype(np.uint64), 2),
+    np.dtype(np.float32): KeyCodec(np.dtype(np.float32), 1, sentinel_pad=True),
+    np.dtype(np.float64): KeyCodec(np.dtype(np.float64), 2, sentinel_pad=True),
 }
 
 

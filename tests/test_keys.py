@@ -45,3 +45,49 @@ def test_sentinel_is_max():
         assert np.all(sent == 0xFFFFFFFF)
         decoded = codec.decode(tuple(np.full(1, s, np.uint32) for s in codec.max_sentinel()))
         assert decoded[0] == np.iinfo(np.dtype(dtype)).max
+
+
+FLOAT_DTYPES = [np.float32, np.float64]
+
+
+def _float_specials(dtype, rng):
+    f = np.dtype(dtype)
+    x = rng.standard_normal(1000).astype(f) * 1e10
+    specials = np.array(
+        [0.0, -0.0, np.inf, -np.inf, np.nan, -np.nan, 1e-40, -1e-40],
+        dtype=f,
+    )
+    return np.concatenate([x, specials])
+
+
+@pytest.mark.parametrize("dtype", FLOAT_DTYPES)
+def test_float_roundtrip_bits(dtype, rng):
+    """encode∘decode is the identity on BITS — NaN payloads, -0.0 and
+    denormals all survive exactly."""
+    x = _float_specials(dtype, rng)
+    codec = codec_for(dtype)
+    back = codec.decode(codec.encode(x))
+    np.testing.assert_array_equal(
+        back.view(np.uint32 if dtype == np.float32 else np.uint64),
+        x.view(np.uint32 if dtype == np.float32 else np.uint64),
+    )
+
+
+@pytest.mark.parametrize("dtype", FLOAT_DTYPES)
+def test_float_total_order(dtype, rng):
+    """Word order == IEEE totalOrder: -NaN < -inf < ... < -0.0 < +0.0 <
+    ... < +inf < +NaN (documented divergence from np.sort's NaNs-last)."""
+    x = _float_specials(dtype, rng)
+    codec = codec_for(dtype)
+    words = codec.encode(x)
+    order = np.lexsort(tuple(reversed(words)))
+    s = x[order]
+    finite = s[np.isfinite(s)]
+    assert (np.diff(finite) >= 0).all()
+    # -NaN block at the head, +NaN block at the tail
+    sign = np.signbit(s)
+    assert np.isnan(s[0]) and sign[0]
+    assert np.isnan(s[-1]) and not sign[-1]
+    # -0.0 strictly before +0.0
+    zeros = np.where(s == 0)[0]
+    assert sign[zeros[0]] and not sign[zeros[-1]]

@@ -233,3 +233,34 @@ def test_sample_spmd_bitonic_engine(mesh8, rng, monkeypatch):
     x = rng.integers(-(2**31), 2**31 - 1, size=4096, dtype=np.int32)
     got = sort(x, algorithm="sample", mesh=mesh8)
     np.testing.assert_array_equal(got, np.sort(x))
+
+
+@pytest.mark.parametrize("algo", ALGOS)
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+def test_float_keys(algo, dtype, mesh8, rng):
+    """Float keys sort in IEEE totalOrder on the full distributed path
+    (the reference is int-only; this is framework-level breadth).  On
+    NaN-free data with a single zero sign the order equals np.sort."""
+    x = (rng.standard_normal(5000) * 1e6).astype(dtype)
+    got = sort(x, algorithm=algo, mesh=mesh8)
+    np.testing.assert_array_equal(got, np.sort(x))
+
+
+def test_float_nan_and_zero_totalorder(mesh8, rng):
+    """NaNs and signed zeros: multiset of bit patterns preserved, order
+    is totalOrder (-NaN first, +NaN last, -0.0 < +0.0) — documented
+    divergence from np.sort, including for the n%P != 0 padded case."""
+    x = np.concatenate([
+        (rng.standard_normal(997) * 1e3).astype(np.float32),
+        np.array([np.nan, -np.nan, 0.0, -0.0, np.inf, -np.inf], np.float32),
+    ])
+    got = sort(x, algorithm="sample", mesh=mesh8)
+    assert got.shape == x.shape
+    # exact multiset of bit patterns
+    np.testing.assert_array_equal(
+        np.sort(got.view(np.uint32)), np.sort(x.view(np.uint32)))
+    # totalOrder endpoints
+    assert np.isnan(got[0]) and np.signbit(got[0])
+    assert np.isnan(got[-1]) and not np.signbit(got[-1])
+    z = np.where(got == 0)[0]
+    assert np.signbit(got[z[0]]) and not np.signbit(got[z[-1]])

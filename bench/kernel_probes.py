@@ -65,7 +65,7 @@ def main() -> None:
             grid=(nblk,), in_specs=[spec], out_specs=spec,
         )
 
-    def slope(fn, reps=(1, 9), tries=3):
+    def slope(fn, reps=(1, 17), tries=4):
         out = {}
         for r in reps:
             @jax.jit
@@ -84,9 +84,9 @@ def main() -> None:
             out[r] = min(ts)
         return (out[reps[1]] - out[reps[0]]) / (reps[1] - reps[0])
 
-    K = 32
+    K = 96
     probes = [
-        ("copy_pass", kernel_call(lambda v, k: v + 1, 1), 1),
+        ("copy_pass", kernel_call(lambda v, k: v + 1, 1), 1),  # noise floor ~±0.2 ms
         ("vpu_add", kernel_call(lambda v, k: v + k, K), K),
         ("vpu_min_mul_add", kernel_call(lambda v, k: jnp.minimum(v, v * 2 + k), K), K),
         ("sublane_roll", kernel_call(lambda v, k: pltpu.roll(v, 1 << (k % 6), 0), K), K),

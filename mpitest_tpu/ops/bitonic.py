@@ -15,7 +15,8 @@ study in BASELINE.md prices every alternative).
 Design (tpu-first, not a port of any CPU/GPU radix scheme):
 
 - The array lives as ``[nblk, S, 128]`` (row-major flat order), block =
-  ``S*128 = 2^B`` elements sized to VMEM (~1 MiB for B=18).
+  ``S*128 = 2^B`` elements (256 KiB at B=16 — the largest the unrolled
+  layer chain fits in scoped VMEM).
 - One **standard bitonic network over the whole padded array**; layers
   are partitioned by compare distance into three kernels:
 
@@ -23,26 +24,37 @@ Design (tpu-first, not a port of any CPU/GPU radix scheme):
     block (grid over blocks, one HBM round-trip total).  Directions come
     from the *global* flat index, so block b ends sorted ascending /
     descending by the parity the merge stages expect.
-  * ``cross``: one layer at distance >= 2^B — pure elementwise min/max
-    between block pairs ``b`` and ``b ^ D``; the take-min side is
-    constant per block (bit of the block index), so there are no
-    per-element masks at all.
-  * ``intra``: for each merge stage, the trailing B layers (distance
-    < 2^B) fused into one in-VMEM sweep per block.
+  * ``cross``: one layer at block distance >= 8, moved as contiguous
+    8-block groups — pure elementwise min/max between paired groups;
+    the take-min side is constant per group (a block-index bit), so
+    there are no per-element masks at all.
+  * ``merge``: each stage's tail — its lowest <=3 cross layers (the
+    XOR-neighborhood of a contiguous 2^c-block group, paired at the
+    Python level) AND the whole trailing in-block sweep — in one VMEM
+    visit per block.
 
-- Compare distances, stage numbers and pair strides ride in as
-  scalar-prefetch operands (``PrefetchScalarGridSpec``), so each kernel
-  compiles **once** per array shape, not once per layer.
+- Two measured v5e facts shape the inner loop: **lane rolls cost ~15x
+  sublane rolls**, so every distance<128 layer runs on the transposed
+  block where it becomes a sublane roll; and direction selects are
+  dearer than flip bookkeeping, so descending segments are kept
+  bit-flipped (``~x`` reverses int32 order) and every layer is the
+  6-op ascending form.
+- Compare distances and stage numbers ride in as scalar-prefetch
+  operands (``PrefetchScalarGridSpec``), so each kernel compiles
+  **once** per array shape, not once per layer.
 
 The network is oblivious (layer sequence depends only on N), so output
 is deterministic and bit-identical run to run — the same canonical
 sorted bytes ``lax.sort`` or ``qsort`` would produce (reference output
 contract: ``mpi_sample_sort.c:203-205``).
 
-Scope: one-word uint32 keys (the encoded form of int32/uint32 — see
-``ops/keys.py``), key-only (no payload): exactly the flagship
-single-device path.  Multi-word keys and the SPMD per-pass sorts keep
-``lax.sort`` (see ``kernels.local_sort``).
+Scope: one-word uint32 keys (the encoded form of int32/uint32/float32 —
+see ``ops/keys.py``), key-only (no payload): the flagship single-device
+path and the per-shard sorts of the distributed sample sort
+(``kernels.local_sort(engine="bitonic")``).  Multi-word keys and the
+radix per-pass variadic sorts keep ``lax.sort`` — BASELINE.md's design
+study shows the measured 2-word margin does not pay for a second kernel
+family.
 """
 
 from __future__ import annotations
@@ -278,12 +290,13 @@ def _compile_block_sort(nblk: int, s_rows: int, b_log2: int, interpret: bool):
 
 @functools.lru_cache(maxsize=16)
 def _compile_cross(nblk: int, s_rows: int, interpret: bool):
-    """One call exchanges all ``nblk/2`` pairs at block distance ``2^sj``.
+    """One call exchanges every 8-block group with its partner group at
+    group distance ``2^sjg``.
 
     The pair layout rides in through the index maps, which receive the
-    scalar-prefetch ref: grid step ``(p, r)`` loads blocks ``bl`` (bit
-    ``sj`` clear) and ``bl | 2^sj`` and writes the ``r``-side one.  One
-    compilation serves every distance.
+    scalar-prefetch ref: grid step ``(q, r)`` loads groups ``glo`` (bit
+    ``sjg`` clear) and ``glo | 2^sjg`` and writes the ``r``-side one.
+    One compilation serves every distance.
     """
     def pair_map(side):
         def f(q, r, s_ref):

@@ -86,6 +86,21 @@ def measure_native(x: np.ndarray, algo: str, ranks: int) -> float | None:
 
 
 def main() -> None:
+    # BENCH_PLATFORM=cpu[:N] forces an N-device virtual CPU mesh (for
+    # TPU-less CI of the bench contract).  Must land before the first
+    # backend query; this image's sitecustomize pins the platform, so an
+    # env var alone would not stick.
+    plat = os.environ.get("BENCH_PLATFORM")
+    if plat:
+        name, _, ndev = plat.partition(":")
+        if ndev:
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={ndev}"
+            )
+        import jax
+
+        jax.config.update("jax_platforms", name)
     import jax
 
     from mpitest_tpu.models.api import sort

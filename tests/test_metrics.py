@@ -47,3 +47,33 @@ def test_needed_passes_digit12_sorts_correctly(mesh8):
     x = np.array([2**32, 0, -(2**40), 7, 2**33 + 1, -1], np.int64)
     got = sort(x, algorithm="radix", mesh=mesh8, digit_bits=12)
     np.testing.assert_array_equal(got, np.sort(x))
+
+
+def test_bench_driver_contract(tmp_path):
+    """The driver scrapes exactly ONE JSON line from bench.py stdout with
+    the metric/value/unit/vs_baseline fields.  Runs tiny on a 2-device
+    virtual CPU mesh (BENCH_PLATFORM hook) so no TPU is needed."""
+    import json
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    env = dict(
+        os.environ,
+        BENCH_PLATFORM="cpu:2",
+        BENCH_LOG2N="14",
+        BENCH_REPEATS="1",
+        BENCH_NATIVE_RANKS="0",
+    )
+    r = subprocess.run(
+        [sys.executable, str(repo / "bench.py")],
+        capture_output=True, text=True, env=env, timeout=600, cwd=str(repo),
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [l for l in r.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected exactly one stdout line, got {lines}"
+    obj = json.loads(lines[0])
+    assert {"metric", "value", "unit", "vs_baseline"} <= obj.keys()
+    assert obj["unit"] == "Mkeys/s" and obj["value"] > 0

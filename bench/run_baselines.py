@@ -91,17 +91,26 @@ def main() -> None:
     from mpitest_tpu.models.api import sort
     from mpitest_tpu.parallel.mesh import make_mesh
 
+    from mpitest_tpu.utils.trace import Tracer
+
     n_zipf = 1 << max(args.log2n_tpu - 4, 16)
     z = io.generate_zipf(n_zipf, dtype=np.int64, seed=1)
     mesh = make_mesh()
     sort(z, algorithm="sample", mesh=mesh)  # warm/compile + settle caps
+    tr = Tracer()
     t0 = time.perf_counter()
-    out = sort(z, algorithm="sample", mesh=mesh)
+    out = sort(z, algorithm="sample", mesh=mesh, tracer=tr)
     dt = time.perf_counter() - t0
     ok = bool(np.array_equal(out, np.sort(z)))
+    # NOTE: unlike the device-resident headline metric, this row times
+    # the full HOST round-trip — encode, device_put and result decode
+    # ride this image's ~0.1-1 GB/s tunnel, which dominates dt here
+    # (production PCIe/DMA is orders faster); phases_ms attributes it.
     emit({"config": f"tpu_sample_zipf11_int64_2e{n_zipf.bit_length()-1}",
           "metric": "mkeys_per_s", "value": round(n_zipf / dt / 1e6, 2),
-          "correct": ok})
+          "correct": ok, "span": "host_roundtrip",
+          "phases_ms": {k: round(v * 1e3, 1) for k, v in tr.phases.items()},
+          "counters": dict(tr.counters)})
 
     # config 6: the collective micro-bench pair (BASELINE row 7)
     r = subprocess.run(

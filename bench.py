@@ -87,20 +87,16 @@ def measure_native(x: np.ndarray, algo: str, ranks: int) -> float | None:
 
 def main() -> None:
     # BENCH_PLATFORM=cpu[:N] forces an N-device virtual CPU mesh (for
-    # TPU-less CI of the bench contract).  Must land before the first
-    # backend query; this image's sitecustomize pins the platform, so an
-    # env var alone would not stick.
+    # TPU-less CI of the bench contract) via the one shared recipe —
+    # must land before the first backend query.
     plat = os.environ.get("BENCH_PLATFORM")
     if plat:
-        name, _, ndev = plat.partition(":")
-        if ndev:
-            os.environ["XLA_FLAGS"] = (
-                os.environ.get("XLA_FLAGS", "")
-                + f" --xla_force_host_platform_device_count={ndev}"
-            )
-        import jax
+        from mpitest_tpu.utils.platform import ensure_virtual_cpu_devices
 
-        jax.config.update("jax_platforms", name)
+        name, _, ndev = plat.partition(":")
+        if name != "cpu":
+            raise SystemExit(f"BENCH_PLATFORM supports cpu[:N], got {plat!r}")
+        ensure_virtual_cpu_devices(int(ndev) if ndev else 1)
     import jax
 
     from mpitest_tpu.models.api import sort

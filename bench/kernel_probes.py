@@ -7,8 +7,9 @@ that bound any Pallas sort kernel on this hardware: streaming copy
 asymmetry that shaped ``ops/bitonic.py``), block transpose, `lax.sort`,
 and the bitonic engine itself.
 
-Method: slope of chained in-jit calls between two rep counts, with a
-forced scalar ``device_get`` after each timed call —
+Method: slope of chained in-jit calls between two rep counts — (1, 17)
+for the sub-millisecond primitive probes, (1, 3) for the two full sorts
+— with a forced scalar ``device_get`` after each timed call:
 ``block_until_ready`` is advisory over this image's tunnel, and the
 ~0.1-0.2 s fixed dispatch cost swamps single-call timings (the round-1
 numbers in the table at the top of BASELINE.md suffered exactly that).

@@ -1,0 +1,56 @@
+"""Virtual CPU-mesh provisioning — the one copy of an order-sensitive
+recipe.
+
+This image's sitecustomize pins an experimental TPU platform, and both
+the host-platform device-count flag and the platform pin only take
+effect BEFORE the first JAX backend query.  Every entry point that needs
+an N-device virtual CPU mesh (tests, the driver's multichip dryrun,
+TPU-less bench runs) must therefore apply the same two settings in the
+same window — this helper is that recipe, with the guards the inline
+copies lacked: it never re-appends the flag, never silently hijacks a
+process that already initialized a real backend, and is idempotent.
+"""
+
+from __future__ import annotations
+
+import os
+
+_provisioned: int | None = None
+
+
+def _backend_initialized() -> bool:
+    # jax.devices() would *create* the backend; peek at the registry
+    # instead (private, but the only non-initializing probe there is —
+    # pinned-version image, exercised by tests).
+    from jax._src import xla_bridge
+
+    return bool(xla_bridge._backends)
+
+
+def ensure_virtual_cpu_devices(n_devices: int) -> None:
+    """Pin this process to an ``n_devices``-device virtual CPU platform.
+
+    Must be called before the first backend query.  If JAX was already
+    initialized: a no-op when enough devices exist (or this helper
+    already provisioned at least as many), otherwise an actionable
+    error — never a silent platform hijack of a live TPU process.
+    """
+    global _provisioned
+    import jax
+
+    if _provisioned is not None or _backend_initialized():
+        if (_provisioned or 0) >= n_devices or len(jax.devices()) >= n_devices:
+            return
+        raise RuntimeError(
+            f"need {n_devices} devices but JAX is already initialized "
+            f"({_provisioned or len(jax.devices())} available); call "
+            "ensure_virtual_cpu_devices() before any backend query, or "
+            "run in a fresh process with "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={n_devices}"
+        )
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={n_devices}"
+    )
+    jax.config.update("jax_platforms", "cpu")
+    _provisioned = n_devices

@@ -9,15 +9,16 @@ The env/config overrides MUST happen before the first JAX backend query
 (this image's sitecustomize pins an experimental TPU platform).
 """
 
-import os
+import pathlib
+import sys
 
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
-)
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from mpitest_tpu.utils.platform import ensure_virtual_cpu_devices  # noqa: E402
+
+ensure_virtual_cpu_devices(8)
 
 import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -202,20 +202,25 @@ def test_thread_sanitizer_race_check(tmp_path, rng):
         pytest.skip("toolchain lacks -fsanitize=thread runtime")
     keys = rng.integers(-(2**31), 2**31 - 1, size=20_000, dtype=np.int32)
     path = write_keys(tmp_path, keys)
-    for d, binary in (("mpi_sample_sort", "sample_sort"),
-                      ("mpi_radix_sort", "radix_sort")):
-        r = subprocess.run(
-            ["make", "-C", str(REPO / d), "BACKEND=local", "SANITIZE=thread"],
-            capture_output=True, text=True,
-        )
-        assert r.returncode == 0, r.stderr
-        run = run_native(str(REPO / d / binary), path, ranks=8,
-                         env={"TSAN_OPTIONS": "exitcode=66 halt_on_error=1"})
-        assert run.returncode == 0, (run.returncode, run.stderr[-2000:])
-        assert "WARNING: ThreadSanitizer" not in run.stderr
-        # restore the plain binary so later tests don't run under TSan
-        subprocess.run(["make", "-C", str(REPO / d), "BACKEND=local"],
-                       capture_output=True, text=True)
+    try:
+        for d, binary in (("mpi_sample_sort", "sample_sort"),
+                          ("mpi_radix_sort", "radix_sort")):
+            r = subprocess.run(
+                ["make", "-C", str(REPO / d), "BACKEND=local",
+                 "SANITIZE=thread"],
+                capture_output=True, text=True,
+            )
+            assert r.returncode == 0, r.stderr
+            run = run_native(str(REPO / d / binary), path, ranks=8,
+                             env={"TSAN_OPTIONS": "exitcode=66 halt_on_error=1"})
+            assert run.returncode == 0, (run.returncode, run.stderr[-2000:])
+            assert "WARNING: ThreadSanitizer" not in run.stderr
+    finally:
+        # restore plain binaries even when an assert fired, so the rest
+        # of the session never runs under TSan by accident
+        for d in ("mpi_sample_sort", "mpi_radix_sort"):
+            subprocess.run(["make", "-C", str(REPO / d), "BACKEND=local"],
+                           capture_output=True, text=True)
 
 
 def test_backend_tpu_wrapper_generation(tmp_path):

@@ -1,0 +1,30 @@
+"""Final randomized stress validation on the real chip via the public API."""
+import numpy as np, sys
+sys.path.insert(0, "/root/repo")
+import mpitest_tpu
+
+rng = np.random.default_rng(123)
+mesh = mpitest_tpu.make_mesh()
+fails = 0
+cases = []
+for trial in range(14):
+    n = int(rng.integers(1, 3_000_000))
+    dtype = rng.choice([np.int32, np.uint32, np.int64, np.uint64, np.float32, np.float64])
+    algo = rng.choice(["radix", "sample"])
+    dt = np.dtype(dtype)
+    if dt.kind == "f":
+        x = (rng.standard_normal(n) * 10**rng.integers(0, 30)).astype(dt)
+    else:
+        info = np.iinfo(dt)
+        span = rng.choice(["full", "narrow"])
+        if span == "full":
+            x = rng.integers(info.min, info.max, n, dtype=dt, endpoint=True)
+        else:
+            x = rng.integers(0, 1000, n).astype(dt)
+    got = mpitest_tpu.sort(x, algorithm=str(algo), mesh=mesh)
+    ok = np.array_equal(got, np.sort(x))
+    cases.append((n, dt.name, str(algo), ok))
+    if not ok:
+        fails += 1
+        print("FAIL", cases[-1])
+print(f"{len(cases)-fails}/{len(cases)} stress cases OK")

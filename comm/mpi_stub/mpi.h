@@ -2,11 +2,13 @@
  * comm_mpi.c uses, vendored so images WITHOUT an MPI installation can
  * still typecheck the MPI backend (`cc -fsyntax-only -I comm/mpi_stub`).
  *
- * This is a signature-rot guard, not a functional MPI: there is no
- * implementation behind these prototypes, and nothing here may be linked.
- * Real builds use the system <mpi.h> via mpicc (`make BACKEND=mpi`),
- * which shadows this header entirely.  Signatures follow MPI 3.1 §5-6
- * (const-correct send buffers, int counts/displacements).
+ * Two uses: a signature-rot guard (`cc -fsyntax-only`), and — linked
+ * with the sibling mpi_mock.c — a functional SINGLE-RANK runtime that
+ * executes comm_mpi.c end-to-end (`make -C bench mpi-mock`).  Real
+ * multi-rank builds use the system <mpi.h> via mpicc
+ * (`make BACKEND=mpi`), which shadows this header entirely.  Signatures
+ * follow MPI 3.1 §5-6 (const-correct send buffers, int
+ * counts/displacements).
  */
 #ifndef COMM_MPI_STUB_H
 #define COMM_MPI_STUB_H

@@ -1,0 +1,154 @@
+/* mpi_mock.c — a functional SINGLE-RANK implementation of the mpi_stub
+ * surface, so the comm.h MPI backend (comm_mpi.c) can be EXECUTED — not
+ * just typechecked — on images without an MPI installation.
+ *
+ * Rationale: every round-1 artifact could only prove comm_mpi.c's
+ * signatures compile (`cc -fsyntax-only`); its call paths had never run
+ * anywhere.  At P=1 the MPI collectives have exact, trivial semantics
+ * (self-communication: memcpy by counts/displacements; reductions of a
+ * single contribution are the contribution), so linking this file gives
+ * a real end-to-end run of the full driver -> sort -> comm_mpi.c stack
+ * with byte-identical output to the pthreads backend.  This validates
+ * the passthrough's argument plumbing (counts, displacements, datatype
+ * sizes, buffer roles) — exactly what signature checks cannot.
+ *
+ * Semantics notes:
+ *  - MPI_Exscan on rank 0 leaves recvbuf undefined per MPI 3.1 §5.11.2;
+ *    this mock zero-fills it, matching the defined behavior of
+ *    comm_local.c that callers actually rely on.
+ *  - MPI_IN_PLACE is not modeled (comm_mpi.c never uses it).
+ *  - Never link this into a real `make BACKEND=mpi` build: the system
+ *    <mpi.h>/libmpi own those; this file pairs only with mpi_stub/mpi.h.
+ */
+#define _POSIX_C_SOURCE 199309L  /* CLOCK_MONOTONIC under -std=c11 */
+
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+
+#include "mpi.h"
+
+struct mpi_stub_datatype { int size; };
+struct mpi_stub_op { int which; };
+struct mpi_stub_comm { int unused; };
+
+static struct mpi_stub_datatype dt_byte = {1};
+static struct mpi_stub_datatype dt_u32 = {4};
+static struct mpi_stub_datatype dt_u64 = {8};
+static struct mpi_stub_op op_sum = {0}, op_min = {1}, op_max = {2};
+static struct mpi_stub_comm world;
+
+MPI_Comm MPI_COMM_WORLD = &world;
+MPI_Datatype MPI_BYTE = &dt_byte;
+MPI_Datatype MPI_UINT32_T = &dt_u32;
+MPI_Datatype MPI_UINT64_T = &dt_u64;
+MPI_Op MPI_SUM = &op_sum, MPI_MIN = &op_min, MPI_MAX = &op_max;
+
+int MPI_Init(int *argc, char ***argv) { (void)argc; (void)argv; return 0; }
+int MPI_Finalize(void) { return 0; }
+int MPI_Comm_rank(MPI_Comm comm, int *rank) { (void)comm; *rank = 0; return 0; }
+int MPI_Comm_size(MPI_Comm comm, int *size) { (void)comm; *size = 1; return 0; }
+
+int MPI_Abort(MPI_Comm comm, int errorcode) {
+    (void)comm;
+    fprintf(stderr, "MPI_Abort(mock, %d)\n", errorcode);
+    exit(errorcode ? errorcode : 1);
+}
+
+double MPI_Wtime(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (double)ts.tv_sec + (double)ts.tv_nsec * 1e-9;
+}
+
+int MPI_Barrier(MPI_Comm comm) { (void)comm; return 0; }
+
+int MPI_Bcast(void *buffer, int count, MPI_Datatype datatype, int root,
+              MPI_Comm comm) {
+    (void)buffer; (void)count; (void)datatype; (void)root; (void)comm;
+    return 0; /* root's data is already in root's buffer */
+}
+
+static void copy(const void *src, void *dst, int count, MPI_Datatype dt) {
+    if (src != dst && count > 0)
+        memcpy(dst, src, (size_t)count * (size_t)dt->size);
+}
+
+int MPI_Scatter(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+                void *recvbuf, int recvcount, MPI_Datatype recvtype, int root,
+                MPI_Comm comm) {
+    (void)recvcount; (void)recvtype; (void)root; (void)comm;
+    copy(sendbuf, recvbuf, sendcount, sendtype);
+    return 0;
+}
+
+int MPI_Scatterv(const void *sendbuf, const int *sendcounts,
+                 const int *displs, MPI_Datatype sendtype, void *recvbuf,
+                 int recvcount, MPI_Datatype recvtype, int root,
+                 MPI_Comm comm) {
+    (void)recvcount; (void)recvtype; (void)root; (void)comm;
+    copy((const char *)sendbuf + (size_t)displs[0] * (size_t)sendtype->size,
+         recvbuf, sendcounts[0], sendtype);
+    return 0;
+}
+
+int MPI_Gather(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+               void *recvbuf, int recvcount, MPI_Datatype recvtype, int root,
+               MPI_Comm comm) {
+    (void)recvcount; (void)recvtype; (void)root; (void)comm;
+    copy(sendbuf, recvbuf, sendcount, sendtype);
+    return 0;
+}
+
+int MPI_Gatherv(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+                void *recvbuf, const int *recvcounts, const int *displs,
+                MPI_Datatype recvtype, int root, MPI_Comm comm) {
+    (void)recvcounts; (void)root; (void)comm;
+    copy(sendbuf,
+         (char *)recvbuf + (size_t)displs[0] * (size_t)recvtype->size,
+         sendcount, sendtype);
+    return 0;
+}
+
+int MPI_Allgather(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+                  void *recvbuf, int recvcount, MPI_Datatype recvtype,
+                  MPI_Comm comm) {
+    (void)recvcount; (void)recvtype; (void)comm;
+    copy(sendbuf, recvbuf, sendcount, sendtype);
+    return 0;
+}
+
+int MPI_Alltoall(const void *sendbuf, int sendcount, MPI_Datatype sendtype,
+                 void *recvbuf, int recvcount, MPI_Datatype recvtype,
+                 MPI_Comm comm) {
+    (void)recvcount; (void)recvtype; (void)comm;
+    copy(sendbuf, recvbuf, sendcount, sendtype);
+    return 0;
+}
+
+int MPI_Alltoallv(const void *sendbuf, const int *sendcounts,
+                  const int *sdispls, MPI_Datatype sendtype, void *recvbuf,
+                  const int *recvcounts, const int *rdispls,
+                  MPI_Datatype recvtype, MPI_Comm comm) {
+    (void)recvcounts; (void)comm;
+    copy((const char *)sendbuf + (size_t)sdispls[0] * (size_t)sendtype->size,
+         (char *)recvbuf + (size_t)rdispls[0] * (size_t)recvtype->size,
+         sendcounts[0], sendtype);
+    return 0;
+}
+
+int MPI_Allreduce(const void *sendbuf, void *recvbuf, int count,
+                  MPI_Datatype datatype, MPI_Op op, MPI_Comm comm) {
+    (void)op; (void)comm; /* reduction over one contribution = identity */
+    copy(sendbuf, recvbuf, count, datatype);
+    return 0;
+}
+
+int MPI_Exscan(const void *sendbuf, void *recvbuf, int count,
+               MPI_Datatype datatype, MPI_Op op, MPI_Comm comm) {
+    (void)sendbuf; (void)op; (void)comm;
+    if (count > 0)
+        memset(recvbuf, 0, (size_t)count * (size_t)datatype->size);
+    return 0;
+}

@@ -82,3 +82,14 @@ def test_single_device_mesh_fast_path(algo, dtype, rng):
     np.testing.assert_array_equal(got, np.sort(x))
     res = sort(x, algorithm=algo, mesh=mesh1, return_result=True)
     assert res.median_probe() == int(np.sort(x)[x.size // 2 - 1])
+
+
+@pytest.mark.parametrize("algo", ["radix", "sample"])
+def test_device_resident_float32(algo, mesh8, rng):
+    """Device-resident float32 keys: the on-device totalOrder encode
+    (keys.py encode_jax) keeps them off the host; NaN-free data matches
+    np.sort byte-for-byte."""
+    x = (rng.standard_normal(8 * 300 + 7) * 1e6).astype(np.float32)
+    x_dev = jax.device_put(x, jax.devices("cpu")[0])
+    got = sort(x_dev, algorithm=algo, mesh=mesh8)
+    np.testing.assert_array_equal(got, np.sort(x))

@@ -197,6 +197,13 @@ def _use_bitonic(engine: str, n_words: int, n: int) -> bool:
     )
 
 
+def _bitonic_impl() -> str:
+    """Execution form of the bitonic engine: real Mosaic kernels on TPU
+    backends, the Pallas interpreter elsewhere (CPU-mesh tests / forced
+    ``SORT_LOCAL_ENGINE=bitonic`` without a TPU)."""
+    return "bitonic" if jax.default_backend() == "tpu" else "bitonic_interpret"
+
+
 @lru_cache(maxsize=8)
 def _compile_local_device(dtype_name: str, engine: str = "auto"):
     """1-device program for device-resident input: fused encode + sort."""
@@ -205,7 +212,7 @@ def _compile_local_device(dtype_name: str, engine: str = "auto"):
     def f(x):
         words = codec.encode_jax(x)
         if _use_bitonic(engine, len(words), x.size):
-            return (bitonic.bitonic_sort_u32(words[0]),)
+            return kernels.local_sort(words, engine=_bitonic_impl())
         return kernels.local_sort(words)
 
     return jax.jit(f)
@@ -256,7 +263,7 @@ def _compile_local(n_words: int, engine: str = "auto"):
     the program specializes to what the hardware actually needs."""
     def f(*words):
         if _use_bitonic(engine, len(words), words[0].size):
-            return (bitonic.bitonic_sort_u32(words[0]),)
+            return kernels.local_sort(words, engine=_bitonic_impl())
         return kernels.local_sort(words)
 
     return jax.jit(f)
@@ -481,8 +488,8 @@ def sort(
             cap_limit = _round_cap(
                 SAMPLE_CAP_LIMIT_FACTOR * max(1, -(-n // n_ranks)), align
             )
-            spmd_engine = ("bitonic" if _use_bitonic(_local_engine(),
-                                                     codec.n_words, n)
+            spmd_engine = (_bitonic_impl() if _use_bitonic(_local_engine(),
+                                                           codec.n_words, n)
                            else "lax")
             tracer.counters["local_engine"] = spmd_engine
             while True:

@@ -26,14 +26,18 @@ def local_sort(words: Words, engine: str = "lax") -> Words:
     ``engine="bitonic"`` routes one-word keys through the Pallas bitonic
     engine (``ops/bitonic.py``, 1.64x ``lax.sort`` at 2^28 on v5e) —
     including under ``shard_map``, which is how the distributed sample
-    sort accelerates its per-shard sorts on real TPU meshes.  On CPU
-    backends the kernel runs in interpret mode (that is what the virtual
-    CPU-mesh tests exercise); multi-word keys always use ``lax.sort``.
+    sort accelerates its per-shard sorts on real TPU meshes.
+    ``engine="bitonic_interpret"`` runs the same kernel through the
+    Pallas interpreter (the virtual CPU-mesh tests).  The choice is
+    explicit rather than backend-sniffed so that AOT compilation for a
+    TPU *topology* from a CPU-pinned process lowers the real Mosaic
+    kernels (see tests/test_aot_topology.py).  Multi-word keys always
+    use ``lax.sort``.
     """
-    if engine == "bitonic" and len(words) == 1:
+    if engine.startswith("bitonic") and len(words) == 1:
         from mpitest_tpu.ops import bitonic  # local import: optional path
 
-        interpret = jax.default_backend() == "cpu"
+        interpret = engine == "bitonic_interpret"
         return (bitonic.bitonic_sort_u32(words[0], interpret=interpret),)
     if len(words) == 1:
         return (jnp.sort(words[0]),)

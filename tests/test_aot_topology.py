@@ -71,3 +71,29 @@ def test_aot_sample_pallas_v5e8(v5e8_mesh):
                        out_specs=(P(AXIS), P(AXIS), P()), check_vma=False)
     compiled = jax.jit(fn).lower((_sharded_input(v5e8_mesh, n),)).compile()
     assert compiled is not None
+
+
+def test_aot_radix_v5e16_two_slices():
+    """The BASELINE row-5 hardware config (v5e-16 = two 2x4 slices):
+    the radix program compiles over the hybrid DCN+ICI 16-chip mesh —
+    the 1-D logical axis keeps the algorithm topology-agnostic
+    (SURVEY.md §7.3 'Multi-host')."""
+    try:
+        from jax.experimental import topologies
+
+        topo = topologies.get_topology_desc(
+            platform="tpu", topology_name="v5e:2x4", num_slices=2)
+    except Exception as e:  # noqa: BLE001
+        pytest.skip(f"TPU topology AOT unavailable: {type(e).__name__}: {e}")
+    mesh = Mesh(np.array(topo.devices).reshape(-1), (AXIS,))
+    n_chips, n, cap = 16, 1 << 13, 1 << 11
+
+    def step(words):
+        out, mc = radix_sort.radix_sort_spmd(words, 1, 16, n_chips, cap, 2)
+        return out[0], mc
+
+    fn = jax.shard_map(step, mesh=mesh, in_specs=((P(AXIS),),),
+                       out_specs=(P(AXIS), P()))
+    x = jax.ShapeDtypeStruct((n_chips * n,), jnp.uint32,
+                             sharding=NamedSharding(mesh, P(AXIS)))
+    assert jax.jit(fn).lower((x,)).compile() is not None

@@ -104,3 +104,17 @@ def test_debug_dump_sorted(keyfile, capsys, monkeypatch):
     ]
     expect = [int(v) & 0xFFFFFFFF for v in np.sort(keys)]
     assert dump == expect
+
+
+def test_profile_hook_produces_artifacts(keyfile, capsys, monkeypatch, tmp_path):
+    """SORT_PROFILE=<dir> captures a real jax.profiler trace around the
+    sort — verified by artifact presence, not just by the hook running
+    (observability row, SURVEY.md §5)."""
+    path, keys = keyfile
+    logdir = tmp_path / "prof"
+    monkeypatch.setenv("SORT_PROFILE", str(logdir))
+    monkeypatch.setattr(sys, "argv", ["sort_cli.py", path])
+    assert sort_cli.main() == 0
+    capsys.readouterr()
+    artifacts = list(logdir.rglob("*.xplane.pb"))
+    assert artifacts, f"no profiler artifacts under {logdir}"

@@ -64,6 +64,11 @@ class KeyCodec:
     def encode(self, x: np.ndarray) -> tuple[np.ndarray, ...]:
         """Host array -> tuple of uint32 word arrays, most-significant first."""
         x = np.asarray(x, dtype=self.dtype)
+        if self.dtype in (np.dtype(np.int16), np.dtype(np.uint16),
+                          np.dtype(np.int8), np.dtype(np.uint8)):
+            # narrow ints widen losslessly into the 32-bit paths
+            wide = np.int32 if self.dtype.kind == "i" else np.uint32
+            return codec_for(wide).encode(x.astype(wide))
         if self.dtype == np.dtype(np.int32):
             return ((x.view(np.uint32) ^ _SIGN32),)
         if self.dtype == np.dtype(np.uint32):
@@ -86,6 +91,10 @@ class KeyCodec:
         words = tuple(np.asarray(w, dtype=np.uint32) for w in words)
         if len(words) != self.n_words:
             raise ValueError(f"expected {self.n_words} words, got {len(words)}")
+        if self.dtype in (np.dtype(np.int16), np.dtype(np.uint16),
+                          np.dtype(np.int8), np.dtype(np.uint8)):
+            wide = np.int32 if self.dtype.kind == "i" else np.uint32
+            return codec_for(wide).decode(words).astype(self.dtype)
         if self.dtype == np.dtype(np.int32):
             return (words[0] ^ _SIGN32).view(np.int32)
         if self.dtype == np.dtype(np.uint32):
@@ -115,6 +124,10 @@ class KeyCodec:
         import jax.numpy as jnp
         from jax import lax
 
+        if self.dtype in (np.dtype(np.int16), np.dtype(np.uint16),
+                          np.dtype(np.int8), np.dtype(np.uint8)):
+            wide = jnp.int32 if self.dtype.kind == "i" else jnp.uint32
+            return codec_for(np.dtype(wide)).encode_jax(x.astype(wide))
         if self.dtype == np.dtype(np.int32):
             return (lax.bitcast_convert_type(x, jnp.uint32) ^ jnp.uint32(0x80000000),)
         if self.dtype == np.dtype(np.uint32):
@@ -149,6 +162,10 @@ class KeyCodec:
 
 
 _CODECS = {
+    np.dtype(np.int8): KeyCodec(np.dtype(np.int8), 1),
+    np.dtype(np.uint8): KeyCodec(np.dtype(np.uint8), 1),
+    np.dtype(np.int16): KeyCodec(np.dtype(np.int16), 1),
+    np.dtype(np.uint16): KeyCodec(np.dtype(np.uint16), 1),
     np.dtype(np.int32): KeyCodec(np.dtype(np.int32), 1),
     np.dtype(np.uint32): KeyCodec(np.dtype(np.uint32), 1),
     np.dtype(np.int64): KeyCodec(np.dtype(np.int64), 2),

@@ -264,3 +264,14 @@ def test_float_nan_and_zero_totalorder(mesh8, rng):
     assert np.isnan(got[-1]) and not np.signbit(got[-1])
     z = np.where(got == 0)[0]
     assert np.signbit(got[z[0]]) and not np.signbit(got[z[-1]])
+
+
+@pytest.mark.parametrize("dtype", [np.int8, np.uint8, np.int16, np.uint16])
+def test_narrow_int_keys(dtype, mesh8, rng):
+    """Narrow integer dtypes widen losslessly into the 32-bit codec paths
+    and sort on the full distributed machinery."""
+    info = np.iinfo(np.dtype(dtype))
+    x = rng.integers(info.min, info.max, size=3000, dtype=dtype, endpoint=True)
+    got = sort(x, algorithm="radix", mesh=mesh8)
+    assert got.dtype == np.dtype(dtype)
+    np.testing.assert_array_equal(got, np.sort(x))

@@ -117,8 +117,11 @@ def main() -> None:
         f"algo={algo} N=2^{log2n} dtype={dtype} repeats={repeats}")
 
     rng = np.random.default_rng(0)
-    info = np.iinfo(dtype)
-    x = rng.integers(info.min, info.max, size=n, dtype=dtype, endpoint=True)
+    if dtype.kind == "f":
+        x = (rng.standard_normal(n) * 1e12).astype(dtype)
+    else:
+        info = np.iinfo(dtype)
+        x = rng.integers(info.min, info.max, size=n, dtype=dtype, endpoint=True)
     mesh = make_mesh()
 
     # Secondary baseline: single-core np.sort of the same keys (also the

@@ -47,6 +47,25 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def encoded_median(x_or_scalar, dtype: np.dtype) -> int:
+    """Collapse key(s) to one comparable integer for the median probe:
+    the native value for ints; the encoded totalOrder bit pattern for
+    floats (int truncation collides distinct float medians, and
+    ``np.sort``'s placement of ±0.0 at the median index need not match
+    totalOrder).  Arrays are encoded, sorted, and probed at n/2-1;
+    scalars are encoded directly."""
+    from mpitest_tpu.ops.keys import codec_for
+
+    arr = np.asarray(x_or_scalar, dtype=dtype).reshape(-1)
+    if dtype.kind != "f":
+        val = np.sort(arr)[arr.size // 2 - 1] if arr.size > 1 else arr[0]
+        return int(val)
+    words = codec_for(dtype).encode(arr)
+    enc = words[0] if len(words) == 1 else (
+        (words[0].astype(np.uint64) << np.uint64(32)) | words[1])
+    return int(np.sort(enc)[arr.size // 2 - 1]) if arr.size > 1 else int(enc[0])
+
+
 def measure_native(x: np.ndarray, algo: str, ranks: int) -> float | None:
     """Run the repo's native backend (pthreads, `ranks` host-CPU ranks) on
     the same keys; return its own timer's seconds (the reference span:
@@ -97,7 +116,14 @@ def main() -> None:
         if name != "cpu":
             raise SystemExit(f"BENCH_PLATFORM supports cpu[:N], got {plat!r}")
         ensure_virtual_cpu_devices(int(ndev) if ndev else 1)
+    dtype = np.dtype(os.environ.get("BENCH_DTYPE", "int32"))
     import jax
+
+    if dtype.itemsize == 8:
+        # Device-resident 64-bit keys exist only under x64 — without it
+        # jax.device_put silently DOWNCASTS the host array (observed:
+        # float64 2^18 bench produced a wrong sort via a float32 shadow).
+        jax.config.update("jax_enable_x64", True)
 
     from mpitest_tpu.models.api import sort
     from mpitest_tpu.parallel.mesh import key_sharding, make_mesh
@@ -109,28 +135,28 @@ def main() -> None:
     log2n = int(os.environ.get("BENCH_LOG2N", "28" if on_tpu else "20"))
     algo = os.environ.get("BENCH_ALGO", "radix")
     repeats = int(os.environ.get("BENCH_REPEATS", "3"))
-    dtype = np.dtype(os.environ.get("BENCH_DTYPE", "int32"))
     native_ranks = int(os.environ.get("BENCH_NATIVE_RANKS", "8"))
     n = 1 << log2n
 
     log(f"bench: platform={platform} devices={len(jax.devices())} "
         f"algo={algo} N=2^{log2n} dtype={dtype} repeats={repeats}")
 
-    rng = np.random.default_rng(0)
-    if dtype.kind == "f":
-        x = (rng.standard_normal(n) * 1e12).astype(dtype)
-    else:
-        info = np.iinfo(dtype)
-        x = rng.integers(info.min, info.max, size=n, dtype=dtype, endpoint=True)
+    from mpitest_tpu.utils.io import generate
+
+    x = generate("uniform", n, dtype, seed=0)
     mesh = make_mesh()
 
-    # Secondary baseline: single-core np.sort of the same keys (also the
-    # correctness reference for the median probe).
+    # Secondary baseline: single-core np.sort of the same keys.
     t0 = time.perf_counter()
-    ref_median = int(np.sort(x)[n // 2 - 1])
+    xs = np.sort(x)
     np_s = time.perf_counter() - t0
     np_mkeys = n / np_s / 1e6
     log(f"baseline np.sort: {np_s:.3f}s = {np_mkeys:.1f} Mkeys/s")
+    # Correctness reference for the median probe.  Ints reuse the sort
+    # above; floats need the encoded (totalOrder) sort for exact bits.
+    ref_median = (encoded_median(x, dtype) if dtype.kind == "f"
+                  else int(xs[n // 2 - 1]))
+    del xs
 
     # Ingest: place the keys on the mesh once (untimed; rate recorded).
     t0 = time.perf_counter()
@@ -141,7 +167,7 @@ def main() -> None:
 
     # Warmup: compiles the program and settles the exchange cap.
     res = sort(x_dev, algorithm=algo, mesh=mesh, return_result=True)
-    probe = res.median_probe()
+    probe = encoded_median(res.median_probe_raw(), dtype)
     ok = probe == ref_median
     del res  # free the result buffers: at 2^30 two live results OOM HBM
     log(f"median probe: got {probe} expect {ref_median} ({'OK' if ok else 'MISMATCH'})")

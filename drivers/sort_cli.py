@@ -18,8 +18,12 @@ Byte-compatible machine interface (SURVEY.md §5 metrics row):
 Knobs the reference put in ``mpirun -np``/source constants ride env vars
 here: ``SORT_ALGO`` ∈ {sample, radix} (default sample — the reference
 binary of the same name), ``SORT_RANKS`` (mesh size; default all
-devices), ``SORT_DIGIT_BITS`` (radix digit width, default 8),
-``SORT_DTYPE`` (default int32).
+devices), ``SORT_DIGIT_BITS`` (radix digit width, default auto),
+``SORT_DTYPE`` (default int32), ``SORT_CAP_FACTOR`` (exchange cap as a
+multiple of the fair per-peer share, default 2.0 — the principled form
+of the reference's fixed ``1.5*size_bucket`` bucket cap,
+``mpi_sample_sort.c:140``), ``SORT_OVERSAMPLE`` (samples per shard for
+splitter selection, default ``2P-1`` like the reference ``:90``).
 
 Observability (SURVEY.md §5 metrics row — additions the reference
 lacks, off by default so the byte-compatible contract is untouched):
@@ -68,6 +72,13 @@ def main(argv: list[str] | None = None) -> int:
     db_env = os.environ.get("SORT_DIGIT_BITS", "auto")
     digit_bits = None if db_env == "auto" else int(db_env)
     ranks = os.environ.get("SORT_RANKS")
+    cap_factor = float(os.environ.get("SORT_CAP_FACTOR", "2.0"))
+    ov_env = os.environ.get("SORT_OVERSAMPLE")
+    oversample = int(ov_env) if ov_env else None
+    if cap_factor <= 0 or (oversample is not None and oversample < 1):
+        print("[ERROR] SORT_CAP_FACTOR must be > 0 and SORT_OVERSAMPLE >= 1",
+              file=sys.stderr)
+        return 1
 
     try:
         keys = read_keys_text(path, dtype=dtype)
@@ -100,6 +111,7 @@ def main(argv: list[str] | None = None) -> int:
     with jax_profile(os.environ.get("SORT_PROFILE")):
         res = sort(
             keys, algorithm=algo, mesh=mesh, digit_bits=digit_bits,
+            cap_factor=cap_factor, oversample=oversample,
             tracer=tracer, return_result=True,
         )
         out = res.to_numpy()  # materialize = the reference's final Gatherv

@@ -74,24 +74,30 @@ class DistributedSortResult:
             parts.append((np.concatenate(segs) if segs else w[:0])[: self.n_valid])
         return codec.decode(tuple(parts))
 
-    def median_probe(self) -> int:
-        """The reference's correctness probe: the (n/2)-th sorted element
-        (``int_buf[size_input / 2 - 1]``, mpi_sample_sort.c:205)."""
+    def median_probe_raw(self):
+        """The (n/2)-th sorted element as a native-dtype scalar (exact
+        bits — float probes must compare bit patterns, since distinct
+        float medians can collide under int truncation)."""
         idx = self.n_valid // 2 - 1
         if idx < 0:
             raise ValueError("median probe undefined for < 2 keys")
         codec = codec_for(self.dtype)
+        # Slice on device, THEN materialize: one element crosses the
+        # host boundary, not the full multi-GB result.
         if self.counts is None:
-            return int(codec.decode(tuple(np.asarray(w)[idx : idx + 1] for w in self.words))[0])
+            return codec.decode(tuple(np.asarray(w[idx : idx + 1]) for w in self.words))[0]
         cum = np.concatenate([[0], np.cumsum(self.counts)])
         shard = int(np.searchsorted(cum, idx, side="right")) - 1
         off = idx - cum[shard]
         s = self.shard_slots
-        return int(
-            codec.decode(
-                tuple(np.asarray(w)[shard * s + off : shard * s + off + 1] for w in self.words)
-            )[0]
-        )
+        return codec.decode(
+            tuple(np.asarray(w[shard * s + off : shard * s + off + 1]) for w in self.words)
+        )[0]
+
+    def median_probe(self) -> int:
+        """The reference's correctness probe: the (n/2)-th sorted element
+        (``int_buf[size_input / 2 - 1]``, mpi_sample_sort.c:205)."""
+        return int(self.median_probe_raw())
 
 
 def _round_cap(c: int, align: int = 128) -> int:
@@ -134,6 +140,14 @@ def _passes_from_diffs(diffs: tuple[int, ...], digit_bits: int) -> int:
     return 0
 
 
+def _word_diffs(words: tuple[np.ndarray, ...]) -> tuple[int, ...]:
+    """Per-word ``max ^ min`` of host key words (msw first) — the one
+    canonical input to pass planning; empty input has no differing bits."""
+    if words[0].size == 0:
+        return (0,) * len(words)
+    return tuple(int(w.max()) ^ int(w.min()) for w in words)
+
+
 def _needed_passes(words: tuple[np.ndarray, ...], digit_bits: int) -> int:
     """Number of LSD passes actually required: digits above the highest
     globally-differing bit are identical everywhere and can be skipped.
@@ -152,11 +166,7 @@ def _needed_passes(words: tuple[np.ndarray, ...], digit_bits: int) -> int:
     bit-count over the whole key, which would undercount whenever
     ``digit_bits`` does not divide 32.
     """
-    if words[0].size == 0:
-        return 0
-    return _passes_from_diffs(
-        tuple(int(w.max()) ^ int(w.min()) for w in words), digit_bits
-    )
+    return _passes_from_diffs(_word_diffs(words), digit_bits)
 
 
 @lru_cache(maxsize=8)
@@ -476,7 +486,10 @@ def sort(
     if algorithm == "sample":
         if oversample is None:
             oversample = max(2 * n_ranks - 1, 8)
-        oversample = min(oversample, n)
+        # Upper clamp: splitter quality saturates far below this, the
+        # [P, oversample] sample gather replicates to every device, and
+        # evenly_spaced_samples' int32 index math needs d^2 < 2^31.
+        oversample = min(oversample, n, 16_384)
         if words_np is not None and _sample_skew_sniff(words_np, n_ranks):
             tracer.verbose(
                 "sample: quantile splitters degenerate (heavy duplication); "
@@ -535,7 +548,7 @@ def sort(
                 ranges = _compile_word_range(dtype.name)(x.reshape(-1))
                 diffs = tuple(int(lo) ^ int(hi) for lo, hi in ranges)
             else:
-                diffs = tuple(int(w.max()) ^ int(w.min()) for w in words_np)
+                diffs = _word_diffs(words_np)
             if digit_bits is None:
                 # Auto width: a pass costs one full fused sort regardless
                 # of digit width (BASELINE.md roofline), so wider digits

@@ -44,13 +44,6 @@ def local_sort(words: Words, engine: str = "lax") -> Words:
     return tuple(lax.sort(list(words), num_keys=len(words), is_stable=True))
 
 
-def local_sort_with_payload(words: Words, payload: Words) -> tuple[Words, Words]:
-    """Stable sort of keys, carrying payload words along."""
-    ops = list(words) + list(payload)
-    out = lax.sort(ops, num_keys=len(words), is_stable=True)
-    return tuple(out[: len(words)]), tuple(out[len(words):])
-
-
 def digit_at(word: jax.Array, shift: int, bits: int) -> jax.Array:
     """Extract the ``bits``-wide digit at bit offset ``shift`` (int32 result)."""
     mask = jnp.uint32((1 << bits) - 1)
@@ -128,10 +121,16 @@ def evenly_spaced_samples(sorted_words: Words, n_samples: int) -> Words:
     (``mpi_sample_sort.c:96-99``) for n >= 1.
     """
     n = sorted_words[0].shape[0]
-    idx = jnp.clip(
-        (lax.iota(jnp.int32, n_samples).astype(jnp.float32) * (n - 1) / max(n_samples - 1, 1))
-        .astype(jnp.int32),
-        0,
-        n - 1,
-    )
+    # Exact integer floor(i*(n-1)/d) without 32-bit overflow: i*q stays
+    # below n and i*r below d^2 (d ~ 2P is tiny).  Float index math would
+    # lose integer precision for shards beyond 2^24.
+    d = max(n_samples - 1, 1)
+    if d * (d - 1) >= 2**31:
+        raise ValueError(
+            f"n_samples={n_samples} overflows the int32 index math "
+            "(and a sample that large defeats sampling)"
+        )
+    q, r = divmod(n - 1, d)
+    i = lax.iota(jnp.int32, n_samples)
+    idx = jnp.clip(i * q + (i * r) // d, 0, n - 1)
     return tuple(w[idx] for w in sorted_words)

@@ -79,9 +79,20 @@ def write_keys_binary(path: str, keys: np.ndarray) -> None:
 
 
 def generate_uniform(n: int, dtype=np.int32, seed: int = 0) -> np.ndarray:
-    """Uniform random keys over the full range of ``dtype``."""
+    """Uniform random keys over the full range of ``dtype``.
+
+    Float dtypes get finite, sign-symmetric values spanning most of the
+    exponent range (normal significand x per-element power of ten).  No
+    NaN/Inf: the ``np.sort`` median-parity probe must be well-defined
+    (totalOrder NaN placement is the codec's documented divergence,
+    ``ops/keys.py``), and finite wide-exponent keys already exercise
+    every bit of the encode path."""
     rng = np.random.default_rng(seed)
     dt = np.dtype(dtype)
+    if dt.kind == "f":
+        max_exp = 30 if dt.itemsize == 4 else 250
+        expo = rng.integers(-max_exp, max_exp, size=n, endpoint=True)
+        return (rng.standard_normal(n) * 10.0 ** expo).astype(dt)
     info = np.iinfo(dt)
     return rng.integers(info.min, info.max, size=n, dtype=dt, endpoint=True)
 
@@ -92,9 +103,13 @@ def generate_zipf(n: int, a: float = 1.1, dtype=np.int64, seed: int = 0) -> np.n
     overflow paths (the reference overflows silently,
     ``mpi_sample_sort.c:140-144``; this framework detects and retries)."""
     rng = np.random.default_rng(seed)
-    info = np.iinfo(np.dtype(dtype))
+    dt = np.dtype(dtype)
     vals = rng.zipf(a, size=n)
-    return np.clip(vals, None, int(info.max)).astype(dtype)
+    if dt.kind == "f":
+        # heavy-tail draws beyond the float's exact-integer range round;
+        # harmless for sort inputs (the rounded array IS the input)
+        return vals.astype(dt)
+    return np.clip(vals, None, int(np.iinfo(dt).max)).astype(dt)
 
 
 def generate(kind: str, n: int, dtype=np.int32, seed: int = 0) -> np.ndarray:

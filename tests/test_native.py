@@ -185,13 +185,26 @@ def test_native_vs_tpu_golden_parity(binaries, tmp_path, rng):
         assert native_out.tobytes() == tpu_out.tobytes()
 
 
+def scratch_tree(tmp_path):
+    """Copy the native build tree (sources + Makefiles, relative TOP=..
+    layout preserved) into tmp_path so BACKEND/SANITIZE switches never
+    mutate the repo's own binaries (ADVICE r2: the in-repo rebuild raced
+    with the `binaries` fixture under parallel test execution)."""
+    root = tmp_path / "tree"
+    # Sources and Makefiles only: the repo's own build outputs may be
+    # mid-rewrite by a concurrently running make (binaries fixture).
+    skip = shutil.ignore_patterns(".backend-*", "sample_sort", "radix_sort",
+                                  "*_mpimock", "comm_bench", "comm_selftest")
+    for d in ("comm", "native", "mpi_sample_sort", "mpi_radix_sort"):
+        shutil.copytree(REPO / d, root / d, ignore=skip)
+    return root
+
+
 def test_thread_sanitizer_race_check(tmp_path, rng):
     """The pthreads comm backend must be race-clean under TSan — the
     executable race check SURVEY.md §5 prescribes (`make SANITIZE=thread`;
     the reference's hand-rolled collectives carry real races: unwaited
-    Isends reusing one request, mpi_sample_sort.c:37,63).  Builds into a
-    scratch copy of nothing — the per-backend stamp includes the
-    sanitize value, so this build cannot poison the plain binaries."""
+    Isends reusing one request, mpi_sample_sort.c:37,63)."""
     if shutil.which("cc") is None and shutil.which("gcc") is None:
         pytest.skip("no C compiler")
     probe = subprocess.run(
@@ -202,25 +215,18 @@ def test_thread_sanitizer_race_check(tmp_path, rng):
         pytest.skip("toolchain lacks -fsanitize=thread runtime")
     keys = rng.integers(-(2**31), 2**31 - 1, size=20_000, dtype=np.int32)
     path = write_keys(tmp_path, keys)
-    try:
-        for d, binary in (("mpi_sample_sort", "sample_sort"),
-                          ("mpi_radix_sort", "radix_sort")):
-            r = subprocess.run(
-                ["make", "-C", str(REPO / d), "BACKEND=local",
-                 "SANITIZE=thread"],
-                capture_output=True, text=True,
-            )
-            assert r.returncode == 0, r.stderr
-            run = run_native(str(REPO / d / binary), path, ranks=8,
-                             env={"TSAN_OPTIONS": "exitcode=66 halt_on_error=1"})
-            assert run.returncode == 0, (run.returncode, run.stderr[-2000:])
-            assert "WARNING: ThreadSanitizer" not in run.stderr
-    finally:
-        # restore plain binaries even when an assert fired, so the rest
-        # of the session never runs under TSan by accident
-        for d in ("mpi_sample_sort", "mpi_radix_sort"):
-            subprocess.run(["make", "-C", str(REPO / d), "BACKEND=local"],
-                           capture_output=True, text=True)
+    tree = scratch_tree(tmp_path)
+    for d, binary in (("mpi_sample_sort", "sample_sort"),
+                      ("mpi_radix_sort", "radix_sort")):
+        r = subprocess.run(
+            ["make", "-C", str(tree / d), "BACKEND=local", "SANITIZE=thread"],
+            capture_output=True, text=True,
+        )
+        assert r.returncode == 0, r.stderr
+        run = run_native(str(tree / d / binary), path, ranks=8,
+                         env={"TSAN_OPTIONS": "exitcode=66 halt_on_error=1"})
+        assert run.returncode == 0, (run.returncode, run.stderr[-2000:])
+        assert "WARNING: ThreadSanitizer" not in run.stderr
 
 
 def test_backend_tpu_wrapper_generation(tmp_path):
@@ -229,7 +235,7 @@ def test_backend_tpu_wrapper_generation(tmp_path):
     rebuild the native binary (the round-1 stale-binary finding)."""
     if shutil.which("make") is None:
         pytest.skip("no make")
-    d = REPO / "mpi_sample_sort"
+    d = scratch_tree(tmp_path) / "mpi_sample_sort"
     r = subprocess.run(["make", "-C", str(d), "BACKEND=tpu"],
                        capture_output=True, text=True)
     assert r.returncode == 0, r.stderr

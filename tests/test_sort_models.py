@@ -192,6 +192,18 @@ def test_median_probe(mesh8, rng):
         assert res.median_probe() == ref
 
 
+def test_median_probe_raw_float_bits(mesh8, rng):
+    """Float probes compare exact bit patterns via median_probe_raw —
+    int truncation (median_probe) collides distinct float medians
+    (ADVICE r2)."""
+    x = io.generate("uniform", 4001, np.float32, seed=9)
+    res = sort(x, algorithm="radix", mesh=mesh8, return_result=True)
+    raw = res.median_probe_raw()
+    assert raw.dtype == np.float32
+    ref = np.sort(x)[4001 // 2 - 1]
+    assert np.asarray(raw).view(np.uint32) == np.asarray(ref).view(np.uint32)
+
+
 def test_auto_digit_width(mesh8, rng):
     """Full-range int32 auto-plans 16-bit digits -> 2 passes; a narrow
     range still collapses to one cheap 8-bit pass (pass count is what a

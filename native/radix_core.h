@@ -1,0 +1,162 @@
+/* radix_core.h — the rank-resident distributed radix pass loop, shared
+ * by radix_sort.c (its whole algorithm) and sample_sort.c (its skew
+ * fallback: when degenerate splitters would blow the O(n) exchange
+ * bound, the sample program reroutes to this skew-immune core — the
+ * same fallback the TPU path takes, mpitest_tpu/models/api.py
+ * SAMPLE_CAP_LIMIT_FACTOR).
+ *
+ * Design (vs the reference's per-pass root round-trip,
+ * mpi_radix_sort.c:133-195): keys stay RESIDENT on their ranks across
+ * all passes; destination = exact global stable position from two
+ * bins-wide reductions (exscan + allreduce of the digit histogram), so
+ * every rank holds exactly its block size after every pass regardless
+ * of skew.
+ *
+ * Debug contract (the reference's last observable behavior,
+ * mpi_radix_sort.c:142,175-178):
+ *   debug>=1: per pass, "[VERBOSE] %d: Scatter OK LOOP %u - %u" with
+ *             the rank's first/last resident key (the reference prints
+ *             its freshly scattered batch bounds; keys here are already
+ *             resident, same information).
+ *   debug>2:  per pass, "[COMMON] %d: Main Queue Completed, LEN=%zu"
+ *             then one "DUMP: LOOP %u RADIX %d = %u" line per resident
+ *             key — LOOP counts from 1, RADIX is the rank id, the value
+ *             prints as %u of the raw int32 pattern, all exactly like
+ *             the reference.
+ */
+#ifndef RADIX_CORE_H
+#define RADIX_CORE_H
+
+#include "comm.h"
+#include "sort_common.h"
+
+/* Stable counting sort of `m` keys by digit (shift/mask), also filling
+ * hist[bins].  `tmp` is scratch of m elements; result ends in keys. */
+static inline void counting_sort_digit(uint32_t *keys, uint32_t *tmp, size_t m,
+                                       unsigned shift, unsigned bins,
+                                       size_t *hist, size_t *offs) {
+    const uint32_t mask = bins - 1;
+    memset(hist, 0, bins * sizeof(size_t));
+    for (size_t i = 0; i < m; i++) hist[(keys[i] >> shift) & mask]++;
+    size_t acc = 0;
+    for (unsigned b = 0; b < bins; b++) { offs[b] = acc; acc += hist[b]; }
+    for (size_t i = 0; i < m; i++) tmp[offs[(keys[i] >> shift) & mask]++] = keys[i];
+    memcpy(keys, tmp, m * sizeof(uint32_t));
+}
+
+/* Run all needed LSD digit passes over the rank-resident block `mine`
+ * (m = block_count(n, P, rank) keys, bias-encoded).  On return, `mine`
+ * holds block `rank` of the globally sorted array.  `bits` is the digit
+ * width in [1, 16]. */
+static inline void radix_passes_resident(comm_ctx *c, uint32_t *mine,
+                                         size_t m, size_t n, unsigned bits,
+                                         int debug) {
+    const int rank = comm_rank(c), P = comm_size(c);
+    const unsigned bins = 1u << bits;
+
+    /* pass planning: bits above msb(global max^min) are constant */
+    uint32_t lmin = 0xFFFFFFFFu, lmax = 0; /* identities for empty blocks */
+    for (size_t i = 0; i < m; i++) {
+        if (mine[i] < lmin) lmin = mine[i];
+        if (mine[i] > lmax) lmax = mine[i];
+    }
+    uint32_t gmin, gmax;
+    comm_allreduce(c, &lmin, &gmin, 1, COMM_T_U32, COMM_OP_MIN);
+    comm_allreduce(c, &lmax, &gmax, 1, COMM_T_U32, COMM_OP_MAX);
+    uint32_t diff = gmin ^ gmax;
+    unsigned need_bits = 0; /* bound the shift: x>>32 is UB on uint32 */
+    while (need_bits < 32 && (diff >> need_bits)) need_bits++;
+    unsigned passes = (need_bits + bits - 1) / bits;
+    if (debug && rank == 0)
+        printf("[COMMON] 0: %u digit passes of %u bits\n", passes, bits);
+
+    /* comm_exscan/allreduce traffic in uint64; size_t buffers are passed
+     * through directly, which is only sound on LP64. */
+    _Static_assert(sizeof(size_t) == sizeof(uint64_t),
+                   "radix core assumes 64-bit size_t");
+    size_t cap = m + 1;
+    uint32_t *tmp = (uint32_t *)malloc(cap * sizeof(uint32_t));
+    size_t *hist = (size_t *)malloc(bins * sizeof(size_t));
+    size_t *offs = (size_t *)malloc(bins * sizeof(size_t));
+    size_t *before = (size_t *)malloc(bins * sizeof(size_t));
+    size_t *tot = (size_t *)malloc(bins * sizeof(size_t));
+    size_t *scounts = (size_t *)calloc((size_t)P, sizeof(size_t));
+    size_t *sdispls = (size_t *)calloc((size_t)P, sizeof(size_t));
+    size_t *rcounts = (size_t *)malloc((size_t)P * sizeof(size_t));
+    size_t *rdispls = (size_t *)malloc((size_t)P * sizeof(size_t));
+    uint32_t *recvbuf = (uint32_t *)malloc(cap * sizeof(uint32_t));
+
+    for (unsigned pass = 0; pass < passes; pass++) {
+        const unsigned shift = pass * bits;
+        if (debug && m)
+            printf("[VERBOSE] %d: Scatter OK LOOP %u - %u\n", rank,
+                   (uint32_t)key_decode(mine[0]),
+                   (uint32_t)key_decode(mine[m - 1]));
+
+        /* local stable counting sort by this digit (+ histogram) */
+        counting_sort_digit(mine, tmp, m, shift, bins, hist, offs);
+
+        /* Global layout from two bins-wide reductions: before[d] =
+         * Σ_{r<rank} hist_r[d] (the MPI_Exscan census row) and tot[d] =
+         * Σ_r hist_r[d].  My element with digit d, occurrence o sits at
+         * global position digit_base[d] + before[d] + o; walk digits in
+         * order accumulating my segment boundaries to get send counts.
+         * (The reference's MPI_Gather+prefix+Gatherv root dance,
+         * :180-194, reduced to O(bins) replicated data per rank.) */
+        comm_exscan(c, hist, before, bins, COMM_T_U64, COMM_OP_SUM);
+        comm_allreduce(c, hist, tot, bins, COMM_T_U64, COMM_OP_SUM);
+        memset(scounts, 0, (size_t)P * sizeof(size_t));
+        size_t digit_base = 0;
+        for (unsigned d = 0; d < bins; d++) {
+            size_t pos = digit_base + before[d]; /* my run of hist[d] keys */
+            for (size_t o = 0; o < hist[d];) {
+                int owner = block_owner(n, P, pos + o);
+                size_t owner_end = block_start(n, P, owner) + block_count(n, P, owner);
+                size_t take = owner_end - (pos + o);
+                if (take > hist[d] - o) take = hist[d] - o;
+                scounts[owner] += take * sizeof(uint32_t);
+                o += take;
+            }
+            digit_base += tot[d];
+        }
+        size_t acc = 0;
+        for (int p = 0; p < P; p++) { sdispls[p] = acc; acc += scounts[p]; }
+
+        /* counts as data, then the key exchange */
+        comm_alltoall(c, scounts, rcounts, sizeof(size_t));
+        size_t total = 0;
+        for (int p = 0; p < P; p++) { rdispls[p] = total; total += rcounts[p]; }
+        comm_alltoallv(c, mine, scounts, sdispls, recvbuf, rcounts, rdispls);
+
+        /* receiver merge: concatenation is source-major; a stable
+         * counting sort by the SAME digit restores (digit, source,
+         * occurrence) = exact global order (the TPU receiver does this
+         * with one lax.sort; the reference re-gathers to root instead). */
+        memcpy(mine, recvbuf, m * sizeof(uint32_t));
+        counting_sort_digit(mine, tmp, m, shift, bins, hist, offs);
+
+        /* the reference's per-pass intermediate dump
+         * (mpi_radix_sort.c:175-178) */
+        if (debug > 2) {
+            printf("[COMMON] %d: Main Queue Completed, LEN=%zu\n", rank, m);
+            for (size_t i = 0; i < m; i++)
+                printf("DUMP: LOOP %u RADIX %d = %u\n", pass + 1, rank,
+                       (uint32_t)key_decode(mine[i]));
+        }
+    }
+
+    free(tmp); free(hist); free(offs); free(before); free(tot);
+    free(scounts); free(sdispls); free(rcounts); free(rdispls); free(recvbuf);
+}
+
+/* Digit width from the RADIX_BITS env knob (default 8); aborts on an
+ * out-of-range value. */
+static inline unsigned radix_bits_env(comm_ctx *c) {
+    const char *env_bits = getenv("RADIX_BITS");
+    unsigned bits = env_bits ? (unsigned)atoi(env_bits) : 8u;
+    if (bits < 1 || bits > 16)
+        comm_abort(c, 1, "radix_sort: RADIX_BITS must be in [1, 16]");
+    return bits;
+}
+
+#endif /* RADIX_CORE_H */

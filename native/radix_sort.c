@@ -20,40 +20,29 @@
  *     above the highest globally-differing bit are skipped (the
  *     principled form of the number_digits pre-pass, :100).
  *
+ * The pass loop itself lives in radix_core.h (shared with
+ * sample_sort.c's skew fallback), including the reference's per-pass
+ * debug contract: "[VERBOSE] Scatter OK LOOP" at debug>=1 and the
+ * "DUMP: LOOP %u RADIX %u = %u" intermediate dumps at debug>2
+ * (mpi_radix_sort.c:142,175-178).
+ *
  * Output contract matches the reference byte-for-byte: "The n/2-th
  * sorted element: %d" (:201), stderr "Endtime()-Starttime() = %.5f sec"
  * (:203), full "%u|%u" dump at debug>2 (:199).
  */
 #include "comm.h"
+#include "radix_core.h"
 #include "sort_common.h"
 
 typedef struct {
     sort_args a;
 } prog_state;
 
-/* Stable counting sort of `m` keys by digit (shift/mask), also filling
- * hist[bins].  `tmp` is scratch of m elements; result ends in keys. */
-static void counting_sort_digit(uint32_t *keys, uint32_t *tmp, size_t m,
-                                unsigned shift, unsigned bins,
-                                size_t *hist, size_t *offs) {
-    const uint32_t mask = bins - 1;
-    memset(hist, 0, bins * sizeof(size_t));
-    for (size_t i = 0; i < m; i++) hist[(keys[i] >> shift) & mask]++;
-    size_t acc = 0;
-    for (unsigned b = 0; b < bins; b++) { offs[b] = acc; acc += hist[b]; }
-    for (size_t i = 0; i < m; i++) tmp[offs[(keys[i] >> shift) & mask]++] = keys[i];
-    memcpy(keys, tmp, m * sizeof(uint32_t));
-}
-
 static void run(comm_ctx *c, void *vs) {
     prog_state *st = (prog_state *)vs;
     const int rank = comm_rank(c), P = comm_size(c);
     const int debug = st->a.debug;
-    const char *env_bits = getenv("RADIX_BITS");
-    const unsigned bits = env_bits ? (unsigned)atoi(env_bits) : 8u;
-    if (bits < 1 || bits > 16)
-        comm_abort(c, 1, "radix_sort: RADIX_BITS must be in [1, 16]");
-    const unsigned bins = 1u << bits;
+    const unsigned bits = radix_bits_env(c);
 
     /* -- rank 0: read + encode -------------------------------------- */
     uint32_t *all = NULL;
@@ -81,9 +70,7 @@ static void run(comm_ctx *c, void *vs) {
 
     /* -- distribute ONCE; keys stay resident across passes ---------- */
     size_t m = block_count(n, P, rank);
-    size_t cap = m + 1;
-    uint32_t *mine = (uint32_t *)malloc(cap * sizeof(uint32_t));
-    uint32_t *tmp = (uint32_t *)malloc(cap * sizeof(uint32_t));
+    uint32_t *mine = (uint32_t *)malloc((m ? m : 1) * sizeof(uint32_t));
     size_t *counts = (size_t *)malloc((size_t)P * sizeof(size_t));
     size_t *displs = (size_t *)malloc((size_t)P * sizeof(size_t));
     for (int i = 0; i < P; i++) {
@@ -92,94 +79,17 @@ static void run(comm_ctx *c, void *vs) {
     }
     comm_scatterv(c, all, counts, displs, mine, m * sizeof(uint32_t), 0);
 
-    /* -- pass planning: bits above msb(global max^min) are constant -- */
-    uint32_t lmin = 0xFFFFFFFFu, lmax = 0; /* identities for empty blocks */
-    for (size_t i = 0; i < m; i++) {
-        if (mine[i] < lmin) lmin = mine[i];
-        if (mine[i] > lmax) lmax = mine[i];
-    }
-    uint32_t gmin, gmax;
-    comm_allreduce(c, &lmin, &gmin, 1, COMM_T_U32, COMM_OP_MIN);
-    comm_allreduce(c, &lmax, &gmax, 1, COMM_T_U32, COMM_OP_MAX);
-    uint32_t diff = gmin ^ gmax;
-    unsigned need_bits = 0; /* bound the shift: x>>32 is UB on uint32 */
-    while (need_bits < 32 && (diff >> need_bits)) need_bits++;
-    unsigned passes = (need_bits + bits - 1) / bits;
-    if (debug && rank == 0)
-        printf("[COMMON] 0: %u digit passes of %u bits\n", passes, bits);
-
-    /* comm_exscan/allreduce traffic in uint64; size_t buffers are passed
-     * through directly, which is only sound on LP64. */
-    _Static_assert(sizeof(size_t) == sizeof(uint64_t),
-                   "radix_sort assumes 64-bit size_t");
-    size_t *hist = (size_t *)malloc(bins * sizeof(size_t));
-    size_t *offs = (size_t *)malloc(bins * sizeof(size_t));
-    size_t *before = (size_t *)malloc(bins * sizeof(size_t));
-    size_t *tot = (size_t *)malloc(bins * sizeof(size_t));
-    size_t *scounts = (size_t *)calloc((size_t)P, sizeof(size_t));
-    size_t *sdispls = (size_t *)calloc((size_t)P, sizeof(size_t));
-    size_t *rcounts = (size_t *)malloc((size_t)P * sizeof(size_t));
-    size_t *rdispls = (size_t *)malloc((size_t)P * sizeof(size_t));
-    uint32_t *recvbuf = (uint32_t *)malloc(cap * sizeof(uint32_t));
-
-    for (unsigned pass = 0; pass < passes; pass++) {
-        const unsigned shift = pass * bits;
-
-        /* local stable counting sort by this digit (+ histogram) */
-        counting_sort_digit(mine, tmp, m, shift, bins, hist, offs);
-
-        /* Global layout from two bins-wide reductions: before[d] =
-         * Σ_{r<rank} hist_r[d] (the MPI_Exscan census row) and tot[d] =
-         * Σ_r hist_r[d].  My element with digit d, occurrence o sits at
-         * global position digit_base[d] + before[d] + o; walk digits in
-         * order accumulating my segment boundaries to get send counts.
-         * (The reference's MPI_Gather+prefix+Gatherv root dance,
-         * :180-194, reduced to O(bins) replicated data per rank.) */
-        comm_exscan(c, hist, before, bins, COMM_T_U64, COMM_OP_SUM);
-        comm_allreduce(c, hist, tot, bins, COMM_T_U64, COMM_OP_SUM);
-        memset(scounts, 0, (size_t)P * sizeof(size_t));
-        size_t digit_base = 0;
-        for (unsigned d = 0; d < bins; d++) {
-            size_t pos = digit_base + before[d]; /* my run of hist[d] keys */
-            for (size_t o = 0; o < hist[d];) {
-                int owner = block_owner(n, P, pos + o);
-                size_t owner_end = block_start(n, P, owner) + block_count(n, P, owner);
-                size_t take = owner_end - (pos + o);
-                if (take > hist[d] - o) take = hist[d] - o;
-                scounts[owner] += take * sizeof(uint32_t);
-                o += take;
-            }
-            digit_base += tot[d];
-        }
-        size_t acc = 0;
-        for (int p = 0; p < P; p++) { sdispls[p] = acc; acc += scounts[p]; }
-
-        /* counts as data, then the key exchange */
-        comm_alltoall(c, scounts, rcounts, sizeof(size_t));
-        size_t total = 0;
-        for (int p = 0; p < P; p++) { rdispls[p] = total; total += rcounts[p]; }
-        comm_alltoallv(c, mine, scounts, sdispls, recvbuf, rcounts, rdispls);
-
-        /* receiver merge: concatenation is source-major; a stable
-         * counting sort by the SAME digit restores (digit, source,
-         * occurrence) = exact global order (the TPU receiver does this
-         * with one lax.sort; the reference re-gathers to root instead). */
-        memcpy(mine, recvbuf, m * sizeof(uint32_t));
-        counting_sort_digit(mine, tmp, m, shift, bins, hist, offs);
-    }
+    radix_passes_resident(c, mine, m, n, bits, debug);
 
     /* -- gather to root (verification/output only) ------------------ */
-    size_t my_bytes = m * sizeof(uint32_t);
-    comm_gatherv(c, mine, my_bytes, all, counts, displs, 0);
+    comm_gatherv(c, mine, m * sizeof(uint32_t), all, counts, displs, 0);
 
     if (rank == 0) {
         double end = comm_wtime();
         print_result(all, n, end - start, debug);
         free(all);
     }
-    free(mine); free(tmp); free(counts); free(displs);
-    free(hist); free(offs); free(before); free(tot); free(scounts);
-    free(sdispls); free(rcounts); free(rdispls); free(recvbuf);
+    free(mine); free(counts); free(displs);
 }
 
 int main(int argc, char **argv) {

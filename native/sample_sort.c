@@ -21,6 +21,7 @@
  * "Endtime()-Starttime() = %.5f sec" (:207).
  */
 #include "comm.h"
+#include "radix_core.h"
 #include "sort_common.h"
 
 enum { OVERSAMPLE_FACTOR = 2 }; /* samples/rank = 2P-1, like :89 */
@@ -93,6 +94,17 @@ static void run(comm_ctx *c, void *vs) {
         for (int i = 0; i < P - 1; i++)
             printf("[MASTER] Splitter: %u.\n", splitters[i]);
 
+    /* Skew sniff (the TPU path's _sample_skew_sniff contract,
+     * mpitest_tpu/models/api.py): two equal adjacent splitters mean at
+     * least 2/P of the sample mass sits on one key value — every copy
+     * would route to a single rank and its bucket grows O(N).  The
+     * splitters are replicated, so every rank reaches the same verdict
+     * with zero extra communication; reroute to the radix core, whose
+     * destination = exact global position is skew-immune. */
+    int degenerate = 0;
+    for (int i = 0; i + 1 < P - 1; i++)
+        if (splitters[i] == splitters[i + 1]) { degenerate = 1; break; }
+
     /* -- bucket boundaries by binary search over the sorted block --- */
     size_t *scounts = (size_t *)calloc((size_t)P, sizeof(size_t));
     size_t *sdispls = (size_t *)calloc((size_t)P, sizeof(size_t));
@@ -119,13 +131,44 @@ static void run(comm_ctx *c, void *vs) {
     size_t *rdispls = (size_t *)malloc((size_t)P * sizeof(size_t));
     size_t total = 0;
     for (int p = 0; p < P; p++) { rdispls[p] = total; total += rcounts[p]; }
-    uint32_t *bucket = (uint32_t *)malloc((total ? total : 1));
-    comm_alltoallv(c, mine, scounts, sdispls, bucket, rcounts, rdispls);
-    size_t bn = total / sizeof(uint32_t);
-    if (debug) printf("[COMMON] %d: exchange OK, bucket=%zu keys\n", rank, bn);
 
-    /* -- final local sort + gather to root -------------------------- */
-    qsort(bucket, bn, sizeof(uint32_t), cmp_u32);
+    /* Skew bound (the TPU path's SAMPLE_CAP_LIMIT_FACTOR contract,
+     * mpitest_tpu/models/api.py): degenerate splitters under heavy
+     * duplication route every copy of a hot key to one rank, making its
+     * bucket O(N) instead of O(n/P).  If any rank's incoming bucket
+     * would exceed 8·ceil(n/P) keys, all ranks reroute to the radix
+     * core, whose destination = exact global position is skew-immune —
+     * recv memory stays O(n/P) per rank.  The counts are exact and
+     * already exchanged, so detection costs one 8-byte allreduce and
+     * happens BEFORE any key moves (the TPU path must run its padded
+     * exchange to learn the true counts; here they are free). */
+    size_t my_in = total / sizeof(uint32_t), max_in = 0;
+    comm_allreduce(c, &my_in, &max_in, 1, COMM_T_U64, COMM_OP_MAX);
+    size_t cap_keys = 8 * ((n + (size_t)P - 1) / (size_t)P);
+    uint32_t *bucket;
+    size_t bn;
+    if (degenerate || max_in > cap_keys) {
+        if (debug && rank == 0) {
+            if (degenerate)
+                printf("[COMMON] 0: degenerate splitters (heavy duplication); "
+                       "falling back to radix\n");
+            else
+                printf("[COMMON] 0: exchange needs %zu > O(n) bound %zu keys; "
+                       "falling back to radix\n", max_in, cap_keys);
+        }
+        radix_passes_resident(c, mine, m, n, radix_bits_env(c), debug);
+        bn = m;
+        bucket = (uint32_t *)malloc((m ? m : 1) * sizeof(uint32_t));
+        memcpy(bucket, mine, m * sizeof(uint32_t));
+    } else {
+        bucket = (uint32_t *)malloc((total ? total : 1));
+        comm_alltoallv(c, mine, scounts, sdispls, bucket, rcounts, rdispls);
+        bn = my_in;
+        if (debug) printf("[COMMON] %d: exchange OK, bucket=%zu keys\n", rank, bn);
+
+        /* final local sort */
+        qsort(bucket, bn, sizeof(uint32_t), cmp_u32);
+    }
 
     /* Each rank's output offset is the exclusive prefix of bucket sizes —
      * comm_exscan (the :188-192 root-side displacement loop, computed

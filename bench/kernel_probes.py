@@ -104,6 +104,84 @@ def main() -> None:
         metrics.record(f"{name}_ms", round(per * 1e3, 4), "ms")
         print(f"{name:22s} {per*1e3:10.4f}")
 
+    # 2-word lexicographic compare-exchange layer vs the 1-word form —
+    # the measured basis for "the engine stays one-word" (BASELINE.md):
+    # a 64-bit key split into (hi, lo) uint32 planes needs 4 rolls + a
+    # 5-op lexicographic compare each way + per-word selects, vs the
+    # 1-word layer's 2 rolls + min + max + select.  VERDICT r2 #3 asked
+    # for this ratio measured, not projected.
+    def kernel_call2(body, k_reps):
+        def kern(hi_ref, lo_ref, ohi_ref, olo_ref):
+            hi, lo = hi_ref[0], lo_ref[0]
+            for k in range(k_reps):
+                hi, lo = body(hi, lo, k)
+            ohi_ref[0], olo_ref[0] = hi, lo
+        return pl.pallas_call(
+            kern,
+            out_shape=[jax.ShapeDtypeStruct((nblk, s_rows, lanes), jnp.int32)] * 2,
+            grid=(nblk,), in_specs=[spec, spec], out_specs=[spec, spec],
+        )
+
+    def asc_layer_1w(v, k):
+        d, log = 1 << (3 + k % 3), 3 + k % 3  # sublane distances, like bitonic
+        size = v.shape[0]
+        fwd = pltpu.roll(v, size - d, 0)
+        bwd = pltpu.roll(v, d, 0)
+        idx = jax.lax.broadcasted_iota(jnp.int32, v.shape, 0)
+        low = ((idx >> log) & 1) == 0
+        return jnp.where(low, jnp.minimum(v, fwd), jnp.maximum(v, bwd))
+
+    def asc_layer_2w(hi, lo, k):
+        d, log = 1 << (3 + k % 3), 3 + k % 3
+        size = hi.shape[0]
+        fhi, flo = pltpu.roll(hi, size - d, 0), pltpu.roll(lo, size - d, 0)
+        bhi, blo = pltpu.roll(hi, d, 0), pltpu.roll(lo, d, 0)
+        # predicates ride as int32 0/1 — Mosaic rejects selects whose
+        # RESULTS are i1 vectors ("unsupported target bitwidth")
+        lt_f = ((hi < fhi) | ((hi == fhi) & (lo < flo))).astype(jnp.int32)
+        gt_b = ((hi > bhi) | ((hi == bhi) & (lo > blo))).astype(jnp.int32)
+        idx = jax.lax.broadcasted_iota(jnp.int32, hi.shape, 0)
+        low = ((idx >> log) & 1) == 0
+        keep = jnp.where(low, lt_f, gt_b) == 1  # keep self on the winning side
+        out_hi = jnp.where(keep, hi, jnp.where(low, fhi, bhi))
+        out_lo = jnp.where(keep, lo, jnp.where(low, flo, blo))
+        return out_hi, out_lo
+
+    layer1 = kernel_call(asc_layer_1w, K)
+    layer2 = kernel_call2(asc_layer_2w, K)
+    per1 = slope(lambda v: layer1(v)) / K
+    x2 = (x, jnp.asarray(
+        rng.integers(-(2**31), 2**31, n, dtype=np.int32)
+    ).reshape(nblk, s_rows, lanes))
+
+    def slope2(fn, reps=(1, 17), tries=4):
+        out = {}
+        for r in reps:
+            @jax.jit
+            def g(pair, r=r):
+                hi, lo = pair
+                for _ in range(r):
+                    hi, lo = fn(hi, lo)
+                return hi, lo
+            y = g(x2)
+            jax.device_get(y[0].reshape(-1)[:1])
+            ts = []
+            for _ in range(tries):
+                t0 = time.perf_counter()
+                y = g(x2)
+                jax.device_get(y[0].reshape(-1)[:1])
+                ts.append(time.perf_counter() - t0)
+            out[r] = min(ts)
+        return (out[reps[1]] - out[reps[0]]) / (reps[1] - reps[0])
+
+    per2 = slope2(lambda h, l: layer2(h, l)) / K
+    metrics.record("bitonic_layer_1w_ms", round(per1 * 1e3, 4), "ms")
+    metrics.record("bitonic_layer_2w_ms", round(per2 * 1e3, 4), "ms")
+    metrics.record("bitonic_layer_2w_ratio", round(per2 / per1, 3), "x")
+    print(f"{'bitonic_layer_1w':22s} {per1*1e3:10.4f}")
+    print(f"{'bitonic_layer_2w':22s} {per2*1e3:10.4f}   ratio {per2/per1:.2f}x "
+          f"(lax.sort 2-word penalty: 2.08x measured — see BASELINE.md)")
+
     flat = x.reshape(-1)
     def slope_flat(fn, reps=(1, 3)):
         out = {}

@@ -385,6 +385,29 @@ def _compile_skew_sniff(mesh: Mesh, n_words: int, n_valid: int, n_ranks: int):
     return jax.jit(f)
 
 
+def _host_pad_words(codec, flat, dtype, total):
+    """Pad-word tuple for host input shorter than ``total``: the maximum
+    real key (encode is order-preserving, so encoding the host max yields
+    the lexicographically-max word tuple), or the all-ones sentinel for
+    float codecs — ``np.max`` is NaN-poisoned and a NaN "max" need not be
+    the totalOrder maximum.  None when no padding is needed (skips the
+    host max() scan)."""
+    if flat.size >= total:
+        return None
+    if codec.sentinel_pad:
+        return codec.max_sentinel()
+    return tuple(int(w[0]) for w in codec.encode(np.asarray([flat.max()], dtype)))
+
+
+def _auto_digit_bits(diffs: tuple[int, ...]) -> int:
+    """Auto digit width: a pass costs one full fused sort regardless of
+    digit width (BASELINE.md roofline), so wider digits that cut the pass
+    count win outright; 16-bit digits halve full-range int32 to 2 passes.
+    The histogram / exscan metadata grows to [P, 65536] int32 — 256 KiB
+    per device per pass, noise next to the shard itself."""
+    return 16 if _passes_from_diffs(diffs, 16) < _passes_from_diffs(diffs, 8) else 8
+
+
 def _shard_input(words_np, mesh, n, pad_words=None):
     P_ = mesh.devices.size
     sharding = key_sharding(mesh)
@@ -427,25 +450,18 @@ def radix_pass_states(x, mesh: Mesh | None = None, digit_bits: int | None = None
     n = max(1, math.ceil(N / n_ranks))
     flat = x.reshape(-1)
     words_np = codec.encode(flat)
-    if N < n_ranks * n:
-        if codec.sentinel_pad:
-            pad = codec.max_sentinel()
-        else:
-            pad = tuple(int(w[0]) for w in codec.encode(np.asarray([flat.max()], dtype)))
-    else:
-        pad = None
+    pad = _host_pad_words(codec, flat, dtype, n_ranks * n)
     words = _shard_input(words_np, mesh, n, pad)
     diffs = _word_diffs(words_np)
     if digit_bits is None:
-        digit_bits = (
-            16 if _passes_from_diffs(diffs, 16) < _passes_from_diffs(diffs, 8)
-            else 8
-        )
+        digit_bits = _auto_digit_bits(diffs)
     passes = _passes_from_diffs(diffs, digit_bits)
     pack_impl = _resolve_pack(pack)
     align = _cap_align(pack_impl)
+    # cap only ever grows: an overflow discovered at pass prefix k would
+    # recur at every k' > k, so keep the grown value across the loop.
+    cap = _round_cap(int(n / n_ranks * cap_factor) + 1, align)
     for k in range(1, passes + 1):
-        cap = _round_cap(int(n / n_ranks * cap_factor) + 1, align)
         while True:
             fn = _compile_radix(mesh, codec.n_words, n, digit_bits, cap, k,
                                 pack_impl)
@@ -550,18 +566,7 @@ def sort(
         with tracer.phase("encode"):
             flat = x.reshape(-1)
             words_np = codec.encode(flat)
-            if N < n_ranks * n:
-                # Pad slots replicate the *maximum real key* (encode is
-                # order-preserving, so encoding the host max yields the
-                # lexicographically-max word tuple).  Float codecs use the
-                # all-ones sentinel: np.max is NaN-poisoned, and a NaN
-                # "max" need not be the totalOrder maximum.
-                if codec.sentinel_pad:
-                    pad = codec.max_sentinel()
-                else:
-                    pad = tuple(int(w[0]) for w in codec.encode(np.asarray([flat.max()], dtype)))
-            else:
-                pad = None  # divisible N: no padding, skip the host max() scan
+            pad = _host_pad_words(codec, flat, dtype, n_ranks * n)
 
         with tracer.phase("device_put"):
             words = _shard_input(words_np, mesh, n, pad)
@@ -656,16 +661,7 @@ def sort(
             else:
                 diffs = _word_diffs(words_np)
             if digit_bits is None:
-                # Auto width: a pass costs one full fused sort regardless
-                # of digit width (BASELINE.md roofline), so wider digits
-                # that cut the pass count win outright; 16-bit digits
-                # halve full-range int32 to 2 passes.  The histogram /
-                # exscan metadata grows to [P, 65536] int32 — 256 KiB per
-                # device per pass, noise next to the shard itself.
-                digit_bits = (
-                    16 if _passes_from_diffs(diffs, 16) < _passes_from_diffs(diffs, 8)
-                    else 8
-                )
+                digit_bits = _auto_digit_bits(diffs)
             passes = _passes_from_diffs(diffs, digit_bits)
         while True:
             fn = _compile_radix(mesh, codec.n_words, n, digit_bits, cap, passes,

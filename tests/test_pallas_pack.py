@@ -43,6 +43,25 @@ def test_models_with_pallas_pack_interpret(algo, mesh4, rng):
     np.testing.assert_array_equal(got, np.sort(x))
 
 
+def test_ragged_gather_probe_correctness():
+    """The linear-work-movement experiment kernel (BASELINE.md round-3
+    section, bench/ragged_gather_probe.py) stays correct: every sweep
+    configuration asserts its dual position-weighted checksum against
+    the numpy concatenation — run here in interpret mode on CPU."""
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    r = subprocess.run(
+        [sys.executable, str(repo / "bench" / "ragged_gather_probe.py"),
+         "--log2n", "14", "--interpret", "--platform", "cpu"],
+        capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "MISMATCH" not in r.stdout + r.stderr
+
+
 def test_segment_pack_empty_segments(rng):
     P, cap = 8, 2 * CHUNK
     data = rng.integers(0, 2**32, 300, dtype=np.uint32)

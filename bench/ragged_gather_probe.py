@@ -47,32 +47,36 @@ ROWS = 8
 CHUNK = ROWS * LANES  # 1024 elements = one output tile
 
 
-def build_descriptors(run_starts, run_lens, order, nchunk, K):
-    """Host-side: for each output chunk, up to K (src_base, dst_off, len)
-    descriptors covering its slice of the permuted-run concatenation."""
+def build_descriptors(run_starts, run_lens, order, nchunk):
+    """Host-side: for each output chunk, the (src_base, dst_off, len)
+    descriptors covering its slice of the permuted-run concatenation.
+    K is sized to the actual maximum segments per chunk — padding slots
+    would otherwise inflate the measured per-chunk cost with dummy
+    DMA+blend work (round-3 review finding)."""
     import numpy as np
 
     starts = np.asarray(run_starts)[order]
     lens = np.asarray(run_lens)[order]
     out_off = np.concatenate([[0], np.cumsum(lens)])
     total = int(out_off[-1])
-    desc = np.zeros((nchunk, K, 3), np.int32)  # (src_base, dst_off, len)
-    counts = np.zeros(nchunk, np.int32)
+    segs = [[] for _ in range(nchunk)]
     for r in range(len(lens)):
         o, ln = int(out_off[r]), int(lens[r])
         src = int(starts[r])
         while ln > 0:
             c = o // CHUNK
             take = min(ln, (c + 1) * CHUNK - o)
-            k = counts[c]
-            assert k < K, f"chunk {c} needs more than K={K} segments"
-            desc[c, k] = (src, o - c * CHUNK, take)
-            counts[c] = k + 1
+            segs[c].append((src, o - c * CHUNK, take))
             o += take
             src += take
             ln -= take
     assert total % CHUNK == 0
-    return desc
+    K = max(len(s) for s in segs)
+    desc = np.zeros((nchunk, K, 3), np.int32)
+    for c, s in enumerate(segs):
+        for k, row in enumerate(s):
+            desc[c, k] = row
+    return desc, K
 
 
 def main() -> None:
@@ -169,16 +173,40 @@ def main() -> None:
         return time.perf_counter() - t0
 
     metrics = Metrics(config={"probe": "ragged_gather", "log2n": args.log2n})
-    print(f"{'run_len':>8s} {'runs':>9s} {'K':>3s} {'ms':>9s} {'GB/s':>7s} "
-          f"{'us/run':>7s}")
+    print(f"{'layout':>8s} {'run_len':>8s} {'runs':>9s} {'K':>3s} {'ms':>9s} "
+          f"{'GB/s':>7s} {'us/run':>7s}")
+    configs = []
     for run_log2 in (13, 12, 11, 10, 9, 8):
+        # aligned: uniform chunk-multiple runs (the kindest case — each
+        # chunk is exactly one segment); ragged: lengths jittered ±25%
+        # like real digit runs, so segments straddle chunk boundaries.
+        configs.append(("aligned", run_log2, False))
+        configs.append(("ragged", run_log2, True))
+    for layout, run_log2, jitter in configs:
         run_len = 1 << run_log2
         nruns = n // run_len
-        starts = np.arange(nruns, dtype=np.int64) * run_len
-        lens = np.full(nruns, run_len, np.int64)
+        if nruns < 1:
+            print(f"{layout:>8s} {run_len:8d} — skipped (n < run_len)")
+            continue
+        if jitter:
+            # bounded ±25% jitter, total corrected back to n by spreading
+            # the residual ±1 per run — lengths stay within [run_len/2,
+            # 3·run_len/2], so the per-chunk segment count (K) stays
+            # bounded instead of spiking on an outlier chunk
+            d = rng.integers(-(run_len // 4), run_len // 4 + 1,
+                             size=nruns).astype(np.int64)
+            d -= d.sum() // nruns
+            res = int(d.sum())
+            sgn = 1 if res < 0 else -1
+            d[: abs(res)] += sgn
+            lens = run_len + d
+            assert int(lens.sum()) == n and (lens > 0).all()
+            starts = np.concatenate([[0], np.cumsum(lens)[:-1]])
+        else:
+            starts = np.arange(nruns, dtype=np.int64) * run_len
+            lens = np.full(nruns, run_len, np.int64)
         order = rng.permutation(nruns)
-        K = max(2, CHUNK // run_len + 1)
-        desc = build_descriptors(starts, lens, order, nchunk, K)
+        desc, K = build_descriptors(starts, lens, order, nchunk)
         desc_j = jnp.asarray(desc)
 
         out = ragged_gather(data, desc_j, K, interpret=args.interpret)
@@ -225,10 +253,10 @@ def main() -> None:
             ts[reps] = min(timed(g, data) for _ in range(3))
         per = (ts[3] - ts[1]) / 2
         gbs = 2 * 4 * n / per / 1e9
-        metrics.record(f"ragged_gather_runlen{run_len}_ms",
+        metrics.record(f"ragged_gather_{layout}_runlen{run_len}_ms",
                        round(per * 1e3, 3), "ms")
-        print(f"{run_len:8d} {nruns:9d} {K:3d} {per*1e3:9.2f} {gbs:7.1f} "
-              f"{per/nruns*1e6:7.3f}")
+        print(f"{layout:>8s} {run_len:8d} {nruns:9d} {K:3d} {per*1e3:9.2f} "
+              f"{gbs:7.1f} {per/nruns*1e6:7.3f}")
     metrics.dump()
 
 

@@ -1,0 +1,201 @@
+/* comm_fuzz — differential randomized tester for the comm.h surface.
+ *
+ * Executes a seeded random sequence of collectives (ragged counts,
+ * zero-length segments, random roots, mixed reduction types) and folds
+ * every byte each rank RECEIVES into a position-weighted checksum; the
+ * combined checksum is printed by rank 0.  The op sequence and all
+ * sizes derive from a PRNG stream shared by every rank (seed, iter), so
+ * the run is deterministic given (seed, iters, P) — and therefore the
+ * printed checksum must be IDENTICAL across comm backends (pthreads,
+ * minimpi multi-process, real MPI).  tests/test_native.py runs the same
+ * seeds on two backends and diffs the lines: a protocol bug that unit
+ * tests miss (count plumbing on an unusual root, a zero-length segment
+ * offset, an exscan edge) shows up as a checksum divergence.
+ *
+ * This extends the test strategy SURVEY.md §4 prescribes (the reference
+ * has no tests at all) from per-primitive closed-form checks
+ * (comm_selftest.c) to randomized cross-backend differential testing.
+ *
+ * Usage: comm_fuzz <seed> <iters>   (ranks from COMM_RANKS / MINIMPI_NP
+ * / mpirun -np; per-op payloads bounded to a few KiB so hundreds of
+ * iterations run in well under a second)
+ */
+#include "comm.h"
+
+#include <inttypes.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#define MAX_ELEMS 1024           /* per-segment u32 payload bound */
+
+/* splitmix64 — tiny deterministic PRNG */
+static uint64_t mix(uint64_t *s) {
+    uint64_t z = (*s += 0x9E3779B97F4A7C15ull);
+    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
+    z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
+    return z ^ (z >> 31);
+}
+
+typedef struct {
+    uint64_t shared;   /* stream identical on every rank: op choices */
+    uint64_t mine;     /* stream per (seed, rank): my payload bytes */
+    uint64_t check;    /* running checksum of received bytes */
+    size_t pos;        /* global fold position */
+} fuzz_state;
+
+static void fold(fuzz_state *f, const void *data, size_t bytes) {
+    const unsigned char *p = (const unsigned char *)data;
+    for (size_t i = 0; i < bytes; i++) {
+        uint64_t x = ((uint64_t)p[i] + 1) * (uint64_t)(f->pos + 0x9E3779B9ull);
+        f->check ^= x + (f->check << 6) + (f->check >> 2);
+        f->pos++;
+    }
+}
+
+static void fill(fuzz_state *f, uint32_t *buf, size_t elems) {
+    for (size_t i = 0; i < elems; i++) buf[i] = (uint32_t)mix(&f->mine);
+}
+
+static void run(comm_ctx *c, void *arg) {
+    uint64_t *args = (uint64_t *)arg;
+    uint64_t seed = args[0];
+    int iters = (int)args[1];
+    const int rank = comm_rank(c), P = comm_size(c);
+
+    fuzz_state f = {
+        .shared = seed * 0x2545F4914F6CDD1Dull + 1,
+        .mine = seed ^ (0xA24BAED4963EE407ull * (uint64_t)(rank + 1)),
+        .check = 0,
+        .pos = 0,
+    };
+
+    uint32_t *a = (uint32_t *)malloc((size_t)P * MAX_ELEMS * sizeof(uint32_t));
+    uint32_t *b = (uint32_t *)malloc((size_t)P * MAX_ELEMS * sizeof(uint32_t));
+    size_t *cnt = (size_t *)malloc((size_t)P * sizeof(size_t));
+    size_t *dsp = (size_t *)malloc((size_t)P * sizeof(size_t));
+    size_t *rcnt = (size_t *)malloc((size_t)P * sizeof(size_t));
+    size_t *rdsp = (size_t *)malloc((size_t)P * sizeof(size_t));
+
+    for (int it = 0; it < iters; it++) {
+        int op = (int)(mix(&f.shared) % 10);
+        int root = (int)(mix(&f.shared) % (uint64_t)P);
+        size_t e = mix(&f.shared) % (MAX_ELEMS + 1); /* may be 0 */
+        switch (op) {
+        case 0: { /* bcast */
+            fill(&f, a, e);
+            comm_bcast(c, a, e * 4, root); /* non-roots overwritten */
+            fold(&f, a, e * 4);
+            break;
+        }
+        case 1: { /* scatter */
+            fill(&f, a, (size_t)P * e);
+            comm_scatter(c, a, b, e * 4, root);
+            fold(&f, b, e * 4);
+            break;
+        }
+        case 2: { /* gather */
+            fill(&f, a, e);
+            comm_gather(c, a, b, e * 4, root);
+            if (rank == root) fold(&f, b, (size_t)P * e * 4);
+            break;
+        }
+        case 3: { /* scatterv: ragged, zeros allowed */
+            size_t tot = 0;
+            for (int i = 0; i < P; i++) {
+                cnt[i] = (mix(&f.shared) % (MAX_ELEMS + 1)) * 4;
+                dsp[i] = tot;
+                tot += cnt[i];
+            }
+            fill(&f, a, tot / 4);
+            comm_scatterv(c, a, cnt, dsp, b, cnt[rank], root);
+            fold(&f, b, cnt[rank]);
+            break;
+        }
+        case 4: { /* gatherv: ragged, zeros allowed */
+            size_t tot = 0;
+            for (int i = 0; i < P; i++) {
+                cnt[i] = (mix(&f.shared) % (MAX_ELEMS + 1)) * 4;
+                dsp[i] = tot;
+                tot += cnt[i];
+            }
+            fill(&f, a, cnt[rank] / 4);
+            comm_gatherv(c, a, cnt[rank], b, cnt, dsp, root);
+            if (rank == root) fold(&f, b, tot);
+            break;
+        }
+        case 5: { /* allgather */
+            fill(&f, a, e);
+            comm_allgather(c, a, b, e * 4);
+            fold(&f, b, (size_t)P * e * 4);
+            break;
+        }
+        case 6: { /* allreduce, typed */
+            comm_type t = (mix(&f.shared) & 1) ? COMM_T_U64 : COMM_T_U32;
+            comm_op o = (comm_op)(mix(&f.shared) % 3);
+            size_t cnt_e = e / (t == COMM_T_U64 ? 2 : 1);
+            fill(&f, a, e);
+            comm_allreduce(c, a, b, cnt_e, t, o);
+            fold(&f, b, cnt_e * (t == COMM_T_U64 ? 8 : 4));
+            break;
+        }
+        case 7: { /* exscan, typed (rank 0 = defined identity) */
+            comm_type t = (mix(&f.shared) & 1) ? COMM_T_U64 : COMM_T_U32;
+            comm_op o = (comm_op)(mix(&f.shared) % 3);
+            size_t cnt_e = e / (t == COMM_T_U64 ? 2 : 1);
+            fill(&f, a, e);
+            comm_exscan(c, a, b, cnt_e, t, o);
+            fold(&f, b, cnt_e * (t == COMM_T_U64 ? 8 : 4));
+            break;
+        }
+        case 8: { /* alltoall */
+            fill(&f, a, (size_t)P * e);
+            comm_alltoall(c, a, b, e * 4);
+            fold(&f, b, (size_t)P * e * 4);
+            break;
+        }
+        default: { /* alltoallv: ragged matrix row per rank */
+            /* every rank derives the FULL [P][P] count matrix from the
+             * shared stream so recv counts/displs are locally known */
+            size_t stot = 0, rtot = 0;
+            for (int i = 0; i < P; i++) {
+                for (int j = 0; j < P; j++) {
+                    size_t bytes = (mix(&f.shared) % (MAX_ELEMS + 1)) * 4;
+                    if (i == rank) { cnt[j] = bytes; }
+                    if (j == rank) { rcnt[i] = bytes; }
+                }
+            }
+            for (int j = 0; j < P; j++) { dsp[j] = stot; stot += cnt[j]; }
+            for (int i = 0; i < P; i++) { rdsp[i] = rtot; rtot += rcnt[i]; }
+            fill(&f, a, stot / 4);
+            comm_alltoallv(c, a, cnt, dsp, b, rcnt, rdsp);
+            fold(&f, b, rtot);
+            break;
+        }
+        }
+        if ((it & 31) == 31) comm_barrier(c);
+    }
+
+    /* combine: every rank's checksum must agree across backends */
+    uint64_t mine2[2] = {f.check, (uint64_t)f.pos}, *all =
+        (uint64_t *)malloc((size_t)P * 2 * sizeof(uint64_t));
+    comm_allgather(c, mine2, all, sizeof mine2);
+    uint64_t combined = 0x243F6A8885A308D3ull;
+    for (int i = 0; i < 2 * P; i++)
+        combined = (combined ^ all[i]) * 0x100000001B3ull;
+    if (rank == 0)
+        printf("comm_fuzz OK seed=%" PRIu64 " iters=%d ranks=%d "
+               "checksum=%016" PRIx64 "\n", seed, iters, P, combined);
+    free(a); free(b); free(cnt); free(dsp); free(rcnt); free(rdsp);
+    free(all);
+}
+
+int main(int argc, char **argv) {
+    if (argc != 3) {
+        fprintf(stderr, "Usage: %s <seed> <iters>\n", argv[0]);
+        return EXIT_FAILURE;
+    }
+    uint64_t args[2] = {strtoull(argv[1], NULL, 10),
+                        strtoull(argv[2], NULL, 10)};
+    return comm_launch(run, args);
+}

@@ -414,6 +414,38 @@ def test_mpi_backend_executes_multirank(algo, n, ranks, minimpi_binaries,
     assert "Endtime()-Starttime() = " in via_mpi.stderr
 
 
+@pytest.fixture(scope="module")
+def comm_fuzz_binary(minimpi_binaries):
+    """Local-backend fuzzer build (the minimpi twin comes from mpi-mini)."""
+    r = subprocess.run(["make", "-C", str(REPO / "bench"), "comm_fuzz"],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    return str(REPO / "bench" / "comm_fuzz")
+
+
+@pytest.mark.parametrize("seed", [1, 42, 1234])
+@pytest.mark.parametrize("ranks", [2, 5, 8])
+def test_comm_fuzz_differential(seed, ranks, minimpi_binaries, comm_fuzz_binary):
+    """Randomized differential test of the full comm.h surface: a seeded
+    sequence of collectives (ragged counts, zero segments, random roots,
+    mixed reductions) must fold to the IDENTICAL checksum on the
+    pthreads backend and the multi-process MPI backend — cross-backend
+    protocol bugs the per-primitive selftest can miss show up here."""
+    import os
+
+    local = subprocess.run(
+        [comm_fuzz_binary, str(seed), "200"],
+        capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, COMM_RANKS=str(ranks)),
+    )
+    assert local.returncode == 0, local.stderr
+    via_mpi = run_minimpi(
+        str(REPO / "bench" / "comm_fuzz_minimpi"), [seed, 200], ranks)
+    assert via_mpi.returncode == 0, via_mpi.stderr
+    assert local.stdout.startswith("comm_fuzz OK")
+    assert local.stdout == via_mpi.stdout  # includes the checksum
+
+
 def test_minimpi_abort_contract(minimpi_binaries):
     """MPI_Abort terminates ALL ranks with the abort code (mpirun
     contract) — no hang, no signal-exit rewrite."""

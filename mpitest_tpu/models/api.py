@@ -183,6 +183,38 @@ def _compile_word_range(dtype_name: str):
     return jax.jit(f)
 
 
+#: Memo: the device-side float64 encode failed to lower on this backend
+#: (XLA x64-rewrite gap, see sort() docstring) — later calls route f64
+#: device input straight to the host fallback instead of re-attempting
+#: a doomed (and slow) XLA compile every time.
+_f64_device_encode_broken = False
+
+#: Error-text markers of the known f64 lowering gap ("While rewriting
+#: computation to not contain X64 element types ... %bitcast-convert").
+#: Anything else (OOM, preemption) must re-raise, not masquerade as it.
+_F64_GAP_MARKERS = ("bitcast-convert", "X64 element types")
+
+
+def _is_f64_lowering_gap(e, dtype, codec) -> bool:
+    """True iff ``e`` is the known f64 device-encode lowering gap for a
+    2-word float dtype; memoizes the verdict for later calls."""
+    global _f64_device_encode_broken
+    if not (dtype.kind == "f" and codec.n_words == 2):
+        return False
+    if not any(m in str(e) for m in _F64_GAP_MARKERS):
+        return False
+    _f64_device_encode_broken = True
+    return True
+
+
+def _f64_fallback_engage(tracer):
+    tracer.verbose(
+        "device-side float64 encode unsupported by this backend; "
+        "falling back to one host round-trip"
+    )
+    tracer.count("f64_host_fallback", 1)
+
+
 _LOCAL_ENGINES = ("auto", "bitonic", "lax")
 
 
@@ -505,6 +537,16 @@ def sort(
     path encodes/pads on-device and never round-trips the keys through
     the host — the framework's steady-state contract (keys live sharded
     on the mesh; SURVEY.md §5 long-context row).
+
+    Device-resident ``float64`` caveats (measured on v5e, round 3): TPU
+    stacks without a native f64→u32 bitcast lowering degrade to ONE
+    documented host round-trip (``tracer.counters["f64_host_fallback"]``)
+    instead of an internal compiler error; and on such stacks the
+    *device array itself* is approximate (f64 held via f32-pair
+    emulation — ~2e-15 relative error introduced by ``device_put``,
+    before this function is called).  The sort is always bit-exact with
+    respect to the bits actually resident on the device; host-input
+    float64 is bit-exact, full stop.
     """
     if algorithm not in ("radix", "sample"):
         raise ValueError(f"unknown algorithm {algorithm!r}")
@@ -528,11 +570,28 @@ def sort(
             "bitonic" if _use_bitonic(_local_engine(), codec.n_words, N)
             else "lax"
         )
+        if is_device and _f64_device_encode_broken and dtype.kind == "f" \
+                and codec.n_words == 2:
+            _f64_fallback_engage(tracer)
+            is_device = False
+            x = np.asarray(x)
         if is_device:
-            with tracer.phase("sort"):
-                out = _compile_local_device(dtype.name, _local_engine())(
-                    x.reshape(-1))
-        else:
+            try:
+                with tracer.phase("sort"):
+                    out = _compile_local_device(dtype.name, _local_engine())(
+                        x.reshape(-1))
+            except jax.errors.JaxRuntimeError as e:
+                # float64 device-side encode needs a f64->u32 bitcast some
+                # TPU stacks cannot lower (XLA's x64-rewrite pass lacks the
+                # rule; int64 works).  Degrade to one documented host
+                # round-trip instead of an internal compiler error; every
+                # other runtime failure re-raises untouched.
+                if not _is_f64_lowering_gap(e, dtype, codec):
+                    raise
+                _f64_fallback_engage(tracer)
+                is_device = False
+                x = np.asarray(x)
+        if not is_device:
             with tracer.phase("encode"):
                 words_np = codec.encode(x.reshape(-1))
             with tracer.phase("device_put"):
@@ -547,22 +606,38 @@ def sort(
         with tracer.phase("decode"):
             return res.to_numpy()
 
+    if is_device and _f64_device_encode_broken and dtype.kind == "f" \
+            and codec.n_words == 2:
+        _f64_fallback_engage(tracer)
+        is_device = False
+        x = np.asarray(x)
     if is_device:
         words_np = None
-        with tracer.phase("encode"):
-            x_flat = x.reshape(-1)
-            if N == n_ranks * n:
-                # Land the input on the mesh first (no-op when already
-                # sharded there); a committed single-device array would
-                # otherwise conflict with the jit's mesh-wide out_shardings.
-                x_flat = jax.device_put(x_flat, key_sharding(mesh))
-                words = _compile_encode_pad(dtype.name, N, mesh)(x_flat)
-            else:
-                # Uneven N cannot be mesh-sharded directly; encode+pad
-                # wherever the input lives, then land the even result.
-                ws = _compile_encode_pad(dtype.name, n_ranks * n, None)(x_flat)
-                words = tuple(jax.device_put(w, key_sharding(mesh)) for w in ws)
-    else:
+        try:
+            with tracer.phase("encode"):
+                x_flat = x.reshape(-1)
+                if N == n_ranks * n:
+                    # Land the input on the mesh first (no-op when already
+                    # sharded there); a committed single-device array would
+                    # otherwise conflict with the jit's mesh-wide
+                    # out_shardings.
+                    x_flat = jax.device_put(x_flat, key_sharding(mesh))
+                    words = _compile_encode_pad(dtype.name, N, mesh)(x_flat)
+                else:
+                    # Uneven N cannot be mesh-sharded directly; encode+pad
+                    # wherever the input lives, then land the even result.
+                    ws = _compile_encode_pad(dtype.name, n_ranks * n, None)(x_flat)
+                    words = tuple(jax.device_put(w, key_sharding(mesh))
+                                  for w in ws)
+        except jax.errors.JaxRuntimeError as e:
+            # see the single-device branch: f64->u32 bitcast gap on some
+            # TPU stacks — degrade to one documented host round-trip.
+            if not _is_f64_lowering_gap(e, dtype, codec):
+                raise
+            _f64_fallback_engage(tracer)
+            is_device = False
+            x = np.asarray(x)
+    if not is_device:
         with tracer.phase("encode"):
             flat = x.reshape(-1)
             words_np = codec.encode(flat)

@@ -71,6 +71,72 @@ def test_device_resident_64bit_input(algo, dtype, mesh8, rng):
     np.testing.assert_array_equal(got, np.sort(x))
 
 
+@pytest.mark.parametrize("n_mesh", [1, 8])
+def test_device_resident_float64_host_fallback(n_mesh, rng, monkeypatch):
+    """Some TPU stacks cannot lower the f64→u32 bitcast (XLA's x64
+    rewrite lacks the rule — observed on v5e via this image's AOT
+    service); a device-resident float64 input must then degrade to ONE
+    documented host round-trip and still sort exactly, not surface an
+    internal compiler error.  The failure is injected here (the CPU
+    backend lowers the bitcast fine)."""
+    import jax.errors
+
+    from mpitest_tpu.models import api
+    from mpitest_tpu.utils.trace import Tracer
+
+    calls = {"n": 0}
+
+    def boom(*a, **k):
+        calls["n"] += 1
+
+        def f(*args):
+            raise jax.errors.JaxRuntimeError(
+                "While rewriting computation to not contain X64 element "
+                "types: %bitcast-convert injected")
+        return f
+
+    monkeypatch.setattr(api, "_f64_device_encode_broken", False)
+    monkeypatch.setattr(api, "_compile_encode_pad", boom)
+    monkeypatch.setattr(api, "_compile_local_device", boom)
+    x = (rng.standard_normal(8 * 200 + 3) * 1e9).astype(np.float64)
+    with jax.enable_x64(True):
+        x_dev = jnp.asarray(x)
+        tracer = Tracer()
+        got = sort(x_dev, algorithm="radix", mesh=make_mesh(n_mesh),
+                   tracer=tracer)
+        np.testing.assert_array_equal(got, np.sort(x))
+        assert tracer.counters.get("f64_host_fallback") == 1
+        # the verdict memoizes: the second call must route straight to the
+        # host path without re-attempting the doomed compile
+        first_calls = calls["n"]
+        tracer2 = Tracer()
+        got2 = sort(x_dev, algorithm="radix", mesh=make_mesh(n_mesh),
+                    tracer=tracer2)
+        np.testing.assert_array_equal(got2, np.sort(x))
+        assert tracer2.counters.get("f64_host_fallback") == 1
+        assert calls["n"] == first_calls
+        # int64 must NOT be silently degraded by the same path...
+        y = rng.integers(-(2**62), 2**62, size=1000, dtype=np.int64)
+        y_dev = jnp.asarray(y)
+        with pytest.raises(jax.errors.JaxRuntimeError, match="bitcast"):
+            sort(y_dev, algorithm="radix", mesh=make_mesh(n_mesh))
+    # ...and an unrelated runtime error on f64 (OOM, preemption) must
+    # re-raise, never masquerade as the lowering gap
+    monkeypatch.setattr(api, "_f64_device_encode_broken", False)
+
+    def oom(*a, **k):
+        def f(*args):
+            raise jax.errors.JaxRuntimeError("RESOURCE_EXHAUSTED: injected")
+        return f
+
+    monkeypatch.setattr(api, "_compile_encode_pad", oom)
+    monkeypatch.setattr(api, "_compile_local_device", oom)
+    with jax.enable_x64(True):
+        with pytest.raises(jax.errors.JaxRuntimeError,
+                           match="RESOURCE_EXHAUSTED"):
+            sort(jnp.asarray(x), algorithm="radix", mesh=make_mesh(n_mesh))
+
+
 @pytest.mark.parametrize("algo", ["radix", "sample"])
 @pytest.mark.parametrize("dtype", [np.int32, np.int64])
 def test_single_device_mesh_fast_path(algo, dtype, rng):

@@ -195,24 +195,35 @@ _f64_device_encode_broken = False
 _F64_GAP_MARKERS = ("bitcast-convert", "X64 element types")
 
 
+def _f64_gap_applies(dtype, codec) -> bool:
+    return dtype.kind == "f" and codec.n_words == 2
+
+
 def _is_f64_lowering_gap(e, dtype, codec) -> bool:
     """True iff ``e`` is the known f64 device-encode lowering gap for a
-    2-word float dtype; memoizes the verdict for later calls."""
+    2-word float dtype; memoizes the verdict for later calls.  The
+    markers are fragments of ONE message and must all be present — a
+    different x64-rewrite failure or an unrelated bitcast error is not
+    this gap and must re-raise."""
     global _f64_device_encode_broken
-    if not (dtype.kind == "f" and codec.n_words == 2):
+    if not _f64_gap_applies(dtype, codec):
         return False
-    if not any(m in str(e) for m in _F64_GAP_MARKERS):
+    msg = str(e)
+    if not all(m in msg for m in _F64_GAP_MARKERS):
         return False
     _f64_device_encode_broken = True
     return True
 
 
-def _f64_fallback_engage(tracer):
+def _f64_host_input(x, tracer):
+    """Engage the documented f64 host fallback: tracer breadcrumbs plus
+    the host copy of the device array."""
     tracer.verbose(
         "device-side float64 encode unsupported by this backend; "
         "falling back to one host round-trip"
     )
     tracer.count("f64_host_fallback", 1)
+    return np.asarray(x)
 
 
 _LOCAL_ENGINES = ("auto", "bitonic", "lax")
@@ -570,11 +581,8 @@ def sort(
             "bitonic" if _use_bitonic(_local_engine(), codec.n_words, N)
             else "lax"
         )
-        if is_device and _f64_device_encode_broken and dtype.kind == "f" \
-                and codec.n_words == 2:
-            _f64_fallback_engage(tracer)
-            is_device = False
-            x = np.asarray(x)
+        if is_device and _f64_device_encode_broken and _f64_gap_applies(dtype, codec):
+            x, is_device = _f64_host_input(x, tracer), False
         if is_device:
             try:
                 with tracer.phase("sort"):
@@ -588,9 +596,7 @@ def sort(
                 # other runtime failure re-raises untouched.
                 if not _is_f64_lowering_gap(e, dtype, codec):
                     raise
-                _f64_fallback_engage(tracer)
-                is_device = False
-                x = np.asarray(x)
+                x, is_device = _f64_host_input(x, tracer), False
         if not is_device:
             with tracer.phase("encode"):
                 words_np = codec.encode(x.reshape(-1))
@@ -606,11 +612,8 @@ def sort(
         with tracer.phase("decode"):
             return res.to_numpy()
 
-    if is_device and _f64_device_encode_broken and dtype.kind == "f" \
-            and codec.n_words == 2:
-        _f64_fallback_engage(tracer)
-        is_device = False
-        x = np.asarray(x)
+    if is_device and _f64_device_encode_broken and _f64_gap_applies(dtype, codec):
+        x, is_device = _f64_host_input(x, tracer), False
     if is_device:
         words_np = None
         try:
@@ -634,9 +637,7 @@ def sort(
             # TPU stacks — degrade to one documented host round-trip.
             if not _is_f64_lowering_gap(e, dtype, codec):
                 raise
-            _f64_fallback_engage(tracer)
-            is_device = False
-            x = np.asarray(x)
+            x, is_device = _f64_host_input(x, tracer), False
     if not is_device:
         with tracer.phase("encode"):
             flat = x.reshape(-1)

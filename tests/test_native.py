@@ -446,6 +446,46 @@ def test_comm_fuzz_differential(seed, ranks, minimpi_binaries, comm_fuzz_binary)
     assert local.stdout == via_mpi.stdout  # includes the checksum
 
 
+def test_comm_fuzz_asan_clean(tmp_path):
+    """The full comm stack (comm_local pthreads AND comm_mpi over the
+    multi-process minimpi runtime) must run the randomized collective
+    sequences clean under AddressSanitizer + UBSan — the memory-safety
+    side of the SURVEY §5 sanitizer row (TSan covers the thread side)."""
+    if shutil.which("cc") is None and shutil.which("gcc") is None:
+        pytest.skip("no C compiler")
+    probe = subprocess.run(
+        ["cc", "-fsanitize=address", "-x", "c", "-", "-o", str(tmp_path / "p")],
+        input="int main(void){return 0;}", capture_output=True, text=True,
+    )
+    if probe.returncode != 0:
+        pytest.skip("toolchain lacks -fsanitize=address runtime")
+    import os
+
+    tree = scratch_tree(tmp_path)
+    (tree / "bench").mkdir()
+    shutil.copy(REPO / "bench" / "Makefile", tree / "bench" / "Makefile")
+    r = subprocess.run(
+        ["make", "-C", str(tree / "bench"), "SANITIZE=address,undefined",
+         "comm_fuzz", "comm_fuzz_minimpi"],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stderr
+    env = dict(os.environ, ASAN_OPTIONS="abort_on_error=1")
+    local = subprocess.run(
+        [str(tree / "bench" / "comm_fuzz"), "11", "300"],
+        capture_output=True, text=True, timeout=300,
+        env=dict(env, COMM_RANKS="6"),
+    )
+    assert local.returncode == 0, local.stderr[-2000:]
+    via_mpi = subprocess.run(
+        [str(tree / "bench" / "comm_fuzz_minimpi"), "11", "300"],
+        capture_output=True, text=True, timeout=300,
+        env=dict(env, MINIMPI_NP="6"),
+    )
+    assert via_mpi.returncode == 0, via_mpi.stderr[-2000:]
+    assert local.stdout == via_mpi.stdout and "OK" in local.stdout
+
+
 def test_minimpi_abort_contract(minimpi_binaries):
     """MPI_Abort terminates ALL ranks with the abort code (mpirun
     contract) — no hang, no signal-exit rewrite."""

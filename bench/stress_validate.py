@@ -8,26 +8,28 @@ sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 import mpitest_tpu
 from mpitest_tpu.utils.io import generate
 
-rng = np.random.default_rng(123)
-mesh = mpitest_tpu.make_mesh()
-fails = 0
-cases = []
-for trial in range(14):
-    n = int(rng.integers(1, 3_000_000))
-    dtype = rng.choice([np.int32, np.uint32, np.int64, np.uint64, np.float32, np.float64])
-    algo = rng.choice(["radix", "sample"])
-    dt = np.dtype(dtype)
-    if dt.kind != "f" and rng.choice(["full", "narrow"]) == "narrow":
-        x = rng.integers(0, 1000, n).astype(dt)  # heavy-duplication span
-    else:
-        x = generate("uniform", n, dt, seed=int(rng.integers(2**31)))
-    got = mpitest_tpu.sort(x, algorithm=str(algo), mesh=mesh)
-    ok = np.array_equal(got, np.sort(x))
-    cases.append((n, dt.name, str(algo), ok))
-    if not ok:
-        fails += 1
-        print("FAIL", cases[-1])
-print(f"{len(cases)-fails}/{len(cases)} stress cases OK")
+def randomized_api_battery() -> None:
+    rng = np.random.default_rng(123)
+    mesh = mpitest_tpu.make_mesh()
+    fails = 0
+    cases = []
+    for trial in range(14):
+        n = int(rng.integers(1, 3_000_000))
+        dtype = rng.choice([np.int32, np.uint32, np.int64, np.uint64,
+                            np.float32, np.float64])
+        algo = rng.choice(["radix", "sample"])
+        dt = np.dtype(dtype)
+        if dt.kind != "f" and rng.choice(["full", "narrow"]) == "narrow":
+            x = rng.integers(0, 1000, n).astype(dt)  # heavy-duplication span
+        else:
+            x = generate("uniform", n, dt, seed=int(rng.integers(2**31)))
+        got = mpitest_tpu.sort(x, algorithm=str(algo), mesh=mesh)
+        ok = np.array_equal(got, np.sort(x))
+        cases.append((n, dt.name, str(algo), ok))
+        if not ok:
+            fails += 1
+            print("FAIL", cases[-1])
+    print(f"{len(cases)-fails}/{len(cases)} stress cases OK")
 
 
 def adversarial_patterns_at_scale(log2n: int = 28) -> None:
@@ -68,5 +70,11 @@ def adversarial_patterns_at_scale(log2n: int = 28) -> None:
         print(f"adversarial {name} @2^{log2n}: OK")
 
 
-if __name__ == "__main__" and "--patterns" in sys.argv:
-    adversarial_patterns_at_scale()
+if __name__ == "__main__":
+    # `--patterns` runs ONLY the at-scale adversarial battery (each mode
+    # alone fits a 10-minute chip budget); default = the randomized
+    # cross-dtype API battery.
+    if "--patterns" in sys.argv:
+        adversarial_patterns_at_scale()
+    else:
+        randomized_api_battery()

@@ -289,6 +289,24 @@ def test_native_vs_tpu_golden_parity(binaries, tmp_path, rng):
         assert native_out.tobytes() == tpu_out.tobytes()
 
 
+def require_sanitizer(flags, tmp_path):
+    """Skip unless a working C compiler with the given -fsanitize=FLAGS
+    runtime exists; returns nothing (the make SANITIZE= build finds the
+    compiler itself).  Probes with the discovered compiler and the EXACT
+    flag set the build will use — a cc-less gcc image or a toolchain
+    missing one runtime must skip, not error."""
+    compiler = shutil.which("cc") or shutil.which("gcc")
+    if compiler is None:
+        pytest.skip("no C compiler")
+    probe = subprocess.run(
+        [compiler, f"-fsanitize={flags}", "-x", "c", "-", "-o",
+         str(tmp_path / "san_probe")],
+        input="int main(void){return 0;}", capture_output=True, text=True,
+    )
+    if probe.returncode != 0:
+        pytest.skip(f"toolchain lacks -fsanitize={flags} runtime")
+
+
 def scratch_tree(tmp_path):
     """Copy the native build tree (sources + Makefiles, relative TOP=..
     layout preserved) into tmp_path so BACKEND/SANITIZE switches never
@@ -309,14 +327,7 @@ def test_thread_sanitizer_race_check(tmp_path, rng):
     executable race check SURVEY.md §5 prescribes (`make SANITIZE=thread`;
     the reference's hand-rolled collectives carry real races: unwaited
     Isends reusing one request, mpi_sample_sort.c:37,63)."""
-    if shutil.which("cc") is None and shutil.which("gcc") is None:
-        pytest.skip("no C compiler")
-    probe = subprocess.run(
-        ["cc", "-fsanitize=thread", "-x", "c", "-", "-o", str(tmp_path / "p")],
-        input="int main(void){return 0;}", capture_output=True, text=True,
-    )
-    if probe.returncode != 0:
-        pytest.skip("toolchain lacks -fsanitize=thread runtime")
+    require_sanitizer("thread", tmp_path)
     keys = rng.integers(-(2**31), 2**31 - 1, size=20_000, dtype=np.int32)
     path = write_keys(tmp_path, keys)
     tree = scratch_tree(tmp_path)
@@ -451,14 +462,7 @@ def test_comm_fuzz_asan_clean(tmp_path):
     multi-process minimpi runtime) must run the randomized collective
     sequences clean under AddressSanitizer + UBSan — the memory-safety
     side of the SURVEY §5 sanitizer row (TSan covers the thread side)."""
-    if shutil.which("cc") is None and shutil.which("gcc") is None:
-        pytest.skip("no C compiler")
-    probe = subprocess.run(
-        ["cc", "-fsanitize=address", "-x", "c", "-", "-o", str(tmp_path / "p")],
-        input="int main(void){return 0;}", capture_output=True, text=True,
-    )
-    if probe.returncode != 0:
-        pytest.skip("toolchain lacks -fsanitize=address runtime")
+    require_sanitizer("address,undefined", tmp_path)
     import os
 
     tree = scratch_tree(tmp_path)

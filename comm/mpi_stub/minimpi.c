@@ -42,8 +42,12 @@
  *     message if a single exchange exceeds the staging area
  *     (MINIMPI_SHM_BYTES, default 256 MiB, lazily committed pages).
  *
- * Never link this into a real `make BACKEND=mpi` build: the system
- * <mpi.h>/libmpi own those; this file pairs only with mpi_stub/mpi.h.
+ * This file pairs ONLY with mpi_stub/mpi.h — never mix it with the
+ * system <mpi.h>/libmpi (mismatched ABIs).  `make BACKEND=mpi` links
+ * it automatically as the fallback when mpicc is absent (the binary
+ * then launches via MINIMPI_NP=P / bench/minirun, not mpirun);
+ * REQUIRE_MPICC=1 forbids the fallback where the real thing is
+ * mandatory (CI's real-MPI job).
  */
 #define _GNU_SOURCE /* prctl, MAP_ANONYMOUS */
 

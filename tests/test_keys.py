@@ -91,3 +91,66 @@ def test_float_total_order(dtype, rng):
     # -0.0 strictly before +0.0
     zeros = np.where(s == 0)[0]
     assert sign[zeros[0]] and not sign[zeros[-1]]
+
+
+# ---- property-based (hypothesis): the codec laws hold for ARBITRARY
+# values, not just the sampled corpora above.  hypothesis is optional
+# (not a declared dependency): absent, only these two tests skip. ------
+
+hypothesis = pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+ALL_DTYPES = [np.int8, np.uint8, np.int16, np.uint16,
+              np.int32, np.uint32, np.int64, np.uint64]
+
+
+def _ints_for(dtype):
+    info = np.iinfo(np.dtype(dtype))
+    return st.integers(min_value=int(info.min), max_value=int(info.max))
+
+
+@pytest.mark.parametrize("dtype", ALL_DTYPES)
+@settings(max_examples=25, deadline=None)
+@given(data=st.data())
+def test_property_int_roundtrip_and_order(dtype, data):
+    """For every integer dtype (each with its own example budget) and ANY
+    pair of values: encode∘decode is the identity, and key comparison ==
+    lexicographic unsigned word comparison (the law every sort in this
+    framework rests on)."""
+    a = data.draw(_ints_for(dtype))
+    b = data.draw(_ints_for(dtype))
+    codec = codec_for(dtype)
+    x = np.array([a, b], dtype=dtype)
+    words = codec.encode(x)
+    np.testing.assert_array_equal(codec.decode(words), x)
+    wa = tuple(int(w[0]) for w in words)
+    wb = tuple(int(w[1]) for w in words)
+    assert (a < b) == (wa < wb) and (a == b) == (wa == wb)
+
+
+@settings(max_examples=40, deadline=None)
+@given(data=st.data())
+def test_property_float_roundtrip_and_totalorder(data):
+    """For float32/float64 and ANY bit patterns (including NaN payloads,
+    infinities, denormals, signed zeros): encode∘decode preserves the
+    exact bits, and word order == IEEE-754 totalOrder."""
+    wide = data.draw(st.booleans())
+    ftype, utype = (np.float64, np.uint64) if wide else (np.float32, np.uint32)
+    bits = st.integers(0, 2 ** (64 if wide else 32) - 1)
+    a = data.draw(bits)
+    b = data.draw(bits)
+    x = np.array([a, b], dtype=utype).view(ftype)
+    codec = codec_for(ftype)
+    words = codec.encode(x)
+    np.testing.assert_array_equal(
+        codec.decode(words).view(utype), x.view(utype))
+
+    def total_order_key(u):
+        # IEEE-754 totalOrder as an unsigned integer: flip all bits of
+        # negatives, set the sign bit of non-negatives
+        sign = 1 << (63 if wide else 31)
+        return (~u) & (2 ** (64 if wide else 32) - 1) if u & sign else u | sign
+
+    wa = tuple(int(w[0]) for w in words)
+    wb = tuple(int(w[1]) for w in words)
+    assert (total_order_key(a) < total_order_key(b)) == (wa < wb)

@@ -180,7 +180,7 @@ def main() -> None:
     metrics.record("bitonic_layer_2w_ratio", round(per2 / per1, 3), "x")
     print(f"{'bitonic_layer_1w':22s} {per1*1e3:10.4f}")
     print(f"{'bitonic_layer_2w':22s} {per2*1e3:10.4f}   ratio {per2/per1:.2f}x "
-          f"(lax.sort 2-word penalty: 2.08x measured — see BASELINE.md)")
+          f"(compare against lax.sort's own 2-word penalty — BASELINE.md)")
 
     flat = x.reshape(-1)
     def slope_flat(fn, reps=(1, 3)):

@@ -126,8 +126,21 @@ def _cap_align(pack: str) -> int:
 
 
 def _passes_from_diffs(diffs: tuple[int, ...], digit_bits: int) -> int:
-    """Pass count from per-word ``max ^ min`` diffs (msw first) — the shared
-    core of host- and device-side pass planning (see :func:`_needed_passes`)."""
+    """Number of LSD passes actually required, from per-word ``max ^ min``
+    diffs (msw first) — the one canonical pass planner, shared by the host
+    path (diffs from :func:`_word_diffs`) and the device path (diffs from
+    one scalar min/max sync per word).  Digits above the highest
+    globally-differing bit are identical everywhere and can be skipped —
+    the principled version of the reference's ``number_digits`` pre-pass
+    (``mpi_radix_sort.c:100``).
+
+    Digit alignment restarts at every 32-bit word boundary (the pass loop
+    in :func:`radix_sort_spmd` walks ``per_word`` digits per word), so the
+    count is ``per_word``-per-full-word plus the digits covering the
+    differing bits of the first non-constant word — NOT a contiguous
+    bit-count over the whole key, which would undercount whenever
+    ``digit_bits`` does not divide 32.
+    """
     n_words = len(diffs)
     per_word = (32 + digit_bits - 1) // digit_bits
     for wi, x in enumerate(diffs):  # msw first
@@ -148,27 +161,6 @@ def _word_diffs(words: tuple[np.ndarray, ...]) -> tuple[int, ...]:
     return tuple(int(w.max()) ^ int(w.min()) for w in words)
 
 
-def _needed_passes(words: tuple[np.ndarray, ...], digit_bits: int) -> int:
-    """Number of LSD passes actually required: digits above the highest
-    globally-differing bit are identical everywhere and can be skipped.
-    The principled version of the reference's ``number_digits`` pre-pass
-    (``mpi_radix_sort.c:100``).
-
-    The highest bit at which *any* two keys differ is found per word
-    (msw first) with plain max/min reductions: the first word that is not
-    constant decides — ``msb(max ^ min)`` within it, everything below it
-    needs full coverage anyway.  O(N) reductions, no copies.
-
-    Digit alignment restarts at every 32-bit word boundary (the pass loop
-    in :func:`radix_sort_spmd` walks ``per_word`` digits per word), so the
-    count is ``per_word``-per-full-word plus the digits covering the
-    differing bits of the first non-constant word — NOT a contiguous
-    bit-count over the whole key, which would undercount whenever
-    ``digit_bits`` does not divide 32.
-    """
-    return _passes_from_diffs(_word_diffs(words), digit_bits)
-
-
 @lru_cache(maxsize=8)
 def _compile_word_range(dtype_name: str):
     """Per-word min/max of the encoded key words (msw first) — feeds the
@@ -183,11 +175,31 @@ def _compile_word_range(dtype_name: str):
     return jax.jit(f)
 
 
-#: Memo: the device-side float64 encode failed to lower on this backend
-#: (XLA x64-rewrite gap, see sort() docstring) — later calls route f64
-#: device input straight to the host fallback instead of re-attempting
-#: a doomed (and slow) XLA compile every time.
-_f64_device_encode_broken = False
+#: Memo: platforms whose device-side float64 encode failed to lower
+#: (XLA x64-rewrite gap, see sort() docstring) — later calls on the SAME
+#: platform route f64 device input straight to the host fallback instead
+#: of re-attempting a doomed (and slow) XLA compile every time.  Keyed
+#: per platform so one broken backend never degrades another (e.g. a CPU
+#: mesh in the same process, whose lowering is fine).
+_f64_encode_broken_platforms: set[str] = set()
+
+
+def _device_platform(x) -> str:
+    """Platform string of the device(s) holding ``x`` — the memo key for
+    the single-device path, whose encode compiles where ``x`` lives."""
+    try:
+        return next(iter(x.devices())).platform
+    except Exception:
+        return jax.default_backend()
+
+
+def _mesh_platform(mesh: Mesh) -> str:
+    """Platform the mesh compiles for — the memo key for the sharded
+    path: the failing compile is ``_compile_encode_pad(..., mesh)``, so
+    keying on the *input's* platform would both poison a healthy backend
+    (CPU input, broken TPU mesh) and miss the memo (TPU input, same
+    broken mesh)."""
+    return mesh.devices.flat[0].platform
 
 #: Error-text markers of the known f64 lowering gap ("While rewriting
 #: computation to not contain X64 element types ... %bitcast-convert").
@@ -199,20 +211,25 @@ def _f64_gap_applies(dtype, codec) -> bool:
     return dtype.kind == "f" and codec.n_words == 2
 
 
-def _is_f64_lowering_gap(e, dtype, codec) -> bool:
+def _is_f64_lowering_gap(e, dtype, codec, platform: str) -> bool:
     """True iff ``e`` is the known f64 device-encode lowering gap for a
-    2-word float dtype; memoizes the verdict for later calls.  The
-    markers are fragments of ONE message and must all be present — a
-    different x64-rewrite failure or an unrelated bitcast error is not
-    this gap and must re-raise."""
-    global _f64_device_encode_broken
+    2-word float dtype; memoizes the verdict for later calls on the same
+    platform.  The markers are fragments of ONE message and must all be
+    present — a different x64-rewrite failure or an unrelated bitcast
+    error is not this gap and must re-raise."""
     if not _f64_gap_applies(dtype, codec):
         return False
     msg = str(e)
     if not all(m in msg for m in _F64_GAP_MARKERS):
         return False
-    _f64_device_encode_broken = True
+    _f64_encode_broken_platforms.add(platform)
     return True
+
+
+def _f64_known_broken(platform: str, dtype, codec) -> bool:
+    """Memoized verdict: ``platform`` already tripped the f64 gap."""
+    return (_f64_gap_applies(dtype, codec)
+            and platform in _f64_encode_broken_platforms)
 
 
 def _f64_host_input(x, tracer):
@@ -408,13 +425,30 @@ def _compile_skew_sniff(mesh: Mesh, n_words: int, n_valid: int, n_ranks: int):
     and adjacent-equality verdict, computed on the mesh — one tiny
     compile + one scalar sync instead of discovering degeneracy through a
     failed full exchange round + recompile.  Samples index [0, n_valid)
-    only, so pad slots (appended after the real keys) never join."""
+    only, so pad slots (appended after the real keys) never join.
+
+    The sample is a *static* strided ``lax.slice`` (start/stride/limit
+    are Python ints baked into the program) rather than a gather: gather
+    indices carry a dtype, and int32 ones silently wrap for
+    n_valid ≥ 2^31 (ADVICE r3 #1) — a strided slice has no index array
+    to overflow, at any scale.  The slice is anchored so its LAST pick
+    is exactly index n_valid-1 (like the host twin's linspace endpoint):
+    anchoring at 0 instead would leave up to ~n_valid/2 tail keys — and
+    the global max — outside the sample."""
     s = min(n_valid, max(64, 32 * n_ranks))
-    idx = np.linspace(0, n_valid - 1, s).astype(np.int32)
+    if s > 1:
+        stride = max(1, (n_valid - 1) // (s - 1))
+        s = (n_valid - 1) // stride + 1   # picks that fit the range
+        start = (n_valid - 1) - (s - 1) * stride  # last pick = n_valid-1
+    else:
+        stride, start = 1, 0
     qpos = (np.arange(1, n_ranks) * s) // n_ranks
 
     def f(*words):
-        picks = [w[idx] for w in words]  # msw first = lexicographic order
+        # msw first = lexicographic order
+        picks = [jax.lax.slice(w, (start,), (start + (s - 1) * stride + 1,),
+                               (stride,))
+                 for w in words]
         sp = jax.lax.sort(picks, num_keys=len(picks), is_stable=False)
         sp = sp if isinstance(sp, (list, tuple)) else (sp,)
         if qpos.size < 2:
@@ -581,7 +615,7 @@ def sort(
             "bitonic" if _use_bitonic(_local_engine(), codec.n_words, N)
             else "lax"
         )
-        if is_device and _f64_device_encode_broken and _f64_gap_applies(dtype, codec):
+        if is_device and _f64_known_broken(_device_platform(x), dtype, codec):
             x, is_device = _f64_host_input(x, tracer), False
         if is_device:
             try:
@@ -594,7 +628,8 @@ def sort(
                 # rule; int64 works).  Degrade to one documented host
                 # round-trip instead of an internal compiler error; every
                 # other runtime failure re-raises untouched.
-                if not _is_f64_lowering_gap(e, dtype, codec):
+                if not _is_f64_lowering_gap(e, dtype, codec,
+                                            _device_platform(x)):
                     raise
                 x, is_device = _f64_host_input(x, tracer), False
         if not is_device:
@@ -612,7 +647,7 @@ def sort(
         with tracer.phase("decode"):
             return res.to_numpy()
 
-    if is_device and _f64_device_encode_broken and _f64_gap_applies(dtype, codec):
+    if is_device and _f64_known_broken(_mesh_platform(mesh), dtype, codec):
         x, is_device = _f64_host_input(x, tracer), False
     if is_device:
         words_np = None
@@ -635,7 +670,9 @@ def sort(
         except jax.errors.JaxRuntimeError as e:
             # see the single-device branch: f64->u32 bitcast gap on some
             # TPU stacks — degrade to one documented host round-trip.
-            if not _is_f64_lowering_gap(e, dtype, codec):
+            # Memo key = the MESH's platform (the compile that failed),
+            # not the input's.
+            if not _is_f64_lowering_gap(e, dtype, codec, _mesh_platform(mesh)):
                 raise
             x, is_device = _f64_host_input(x, tracer), False
     if not is_device:

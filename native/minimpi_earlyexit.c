@@ -1,0 +1,20 @@
+/* Supervision probe for the minimpi runtime (links mpi_stub/mpi.h
+ * directly — this tests the runtime's job control, not the comm.h
+ * surface): rank 1 exits with status 0 BEFORE MPI_Finalize, the
+ * "clean" early return that used to strand every peer in the
+ * process-shared barrier forever.  The supervisor must detect the
+ * unfinalized exit and kill the whole job with a nonzero status
+ * (ADVICE r3: zero-exit-before-finalize hang). */
+#include <stdlib.h>
+
+#include <mpi.h>
+
+int main(int argc, char **argv) {
+    MPI_Init(&argc, &argv);
+    int rank;
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    if (rank == 1) exit(0); /* before Finalize: abnormal in all but status */
+    MPI_Barrier(MPI_COMM_WORLD); /* peers would block here forever */
+    MPI_Finalize();
+    return 0;
+}

@@ -95,7 +95,7 @@ def test_device_resident_float64_host_fallback(n_mesh, rng, monkeypatch):
                 "types: %bitcast-convert injected")
         return f
 
-    monkeypatch.setattr(api, "_f64_device_encode_broken", False)
+    monkeypatch.setattr(api, "_f64_encode_broken_platforms", set())
     monkeypatch.setattr(api, "_compile_encode_pad", boom)
     monkeypatch.setattr(api, "_compile_local_device", boom)
     x = (rng.standard_normal(8 * 200 + 3) * 1e9).astype(np.float64)
@@ -127,7 +127,7 @@ def test_device_resident_float64_host_fallback(n_mesh, rng, monkeypatch):
     for msg in ("RESOURCE_EXHAUSTED: injected",
                 "some other bitcast-convert failure",
                 "X64 element types trouble elsewhere"):
-        monkeypatch.setattr(api, "_f64_device_encode_broken", False)
+        monkeypatch.setattr(api, "_f64_encode_broken_platforms", set())
 
         def other(*a, _msg=msg, **k):
             def f(*args):
@@ -140,7 +140,7 @@ def test_device_resident_float64_host_fallback(n_mesh, rng, monkeypatch):
             with pytest.raises(jax.errors.JaxRuntimeError,
                                match=msg.split()[0].split(":")[0]):
                 sort(jnp.asarray(x), algorithm="radix", mesh=make_mesh(n_mesh))
-        assert api._f64_device_encode_broken is False
+        assert not api._f64_encode_broken_platforms
 
 
 @pytest.mark.parametrize("algo", ["radix", "sample"])

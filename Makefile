@@ -4,11 +4,19 @@
 #                  mesh via tests/conftest.py) + native builds + shim
 #                  selftest + MPI-backend typecheck
 #   make native  — build both sort binaries (local backend) + bench tools
+#   make chip-test — ON-CHIP regression gate (needs a real TPU): real-
+#                  Mosaic bitonic vs lax.sort numerics + timing at 2^26,
+#                  segment_pack, the 5-pattern adversarial battery; one
+#                  JSONL row appended to bench/BASELINE_RESULTS.jsonl.
+#                  Finishes in minutes — run it in every chip session.
 #   make clean   — remove all build artifacts
 
 PYTHON ?= python3
 
-.PHONY: test native clean
+.PHONY: test native chip-test clean
+
+chip-test:
+	$(PYTHON) -u bench/chip_regression.py
 
 test: native
 	$(PYTHON) -m pytest tests/ -q

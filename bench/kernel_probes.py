@@ -147,8 +147,48 @@ def main() -> None:
         out_lo = jnp.where(keep, lo, jnp.where(low, flo, blo))
         return out_hi, out_lo
 
+    def asc_layer_kp(k, p, kk):
+        """Key+payload compare-exchange: min/max on the KEY plane (the
+        1-word form, no lexicographic predicate chain) plus one <=/>=
+        predicate that routes the PAYLOAD plane.  The core primitive of
+        the MSD-hybrid 64-bit structure (sort by hi word, lo rides as
+        payload; equal keys keep their own payloads — consistent on both
+        sides, so no element is lost).  VERDICT r3 #1 asks this priced
+        before building."""
+        d, log = 1 << (3 + kk % 3), 3 + kk % 3
+        size = k.shape[0]
+        fk, fp = pltpu.roll(k, size - d, 0), pltpu.roll(p, size - d, 0)
+        bk, bp = pltpu.roll(k, d, 0), pltpu.roll(p, d, 0)
+        idx = jax.lax.broadcasted_iota(jnp.int32, k.shape, 0)
+        low = ((idx >> log) & 1) == 0
+        out_k = jnp.where(low, jnp.minimum(k, fk), jnp.maximum(k, bk))
+        # int32 0/1 predicates: Mosaic rejects i1-vector select results
+        le = (k <= fk).astype(jnp.int32)
+        ge = (k >= bk).astype(jnp.int32)
+        keep = jnp.where(low, le, ge) == 1
+        out_p = jnp.where(keep, p, jnp.where(low, fp, bp))
+        return out_k, out_p
+
+    def asc_layer_kp2(k, p, kk):
+        """kp variant: payload route derived from the key RESULT —
+        ``keep = (out_k == k)`` (low side: out==k ⟺ k<=partner; high:
+        ⟺ k>=partner; ties keep own payload on BOTH sides — a
+        consistent no-swap).  One equality replaces two compares + two
+        int32 casts + one select of the naive kp form."""
+        d, log = 1 << (3 + kk % 3), 3 + kk % 3
+        size = k.shape[0]
+        fk, fp = pltpu.roll(k, size - d, 0), pltpu.roll(p, size - d, 0)
+        bk, bp = pltpu.roll(k, d, 0), pltpu.roll(p, d, 0)
+        idx = jax.lax.broadcasted_iota(jnp.int32, k.shape, 0)
+        low = ((idx >> log) & 1) == 0
+        out_k = jnp.where(low, jnp.minimum(k, fk), jnp.maximum(k, bk))
+        out_p = jnp.where(out_k == k, p, jnp.where(low, fp, bp))
+        return out_k, out_p
+
     layer1 = kernel_call(asc_layer_1w, K)
     layer2 = kernel_call2(asc_layer_2w, K)
+    layerkp = kernel_call2(asc_layer_kp, K)
+    layerkp2 = kernel_call2(asc_layer_kp2, K)
     per1 = slope(lambda v: layer1(v)) / K
     x2 = (x, jnp.asarray(
         rng.integers(-(2**31), 2**31, n, dtype=np.int32)
@@ -175,12 +215,22 @@ def main() -> None:
         return (out[reps[1]] - out[reps[0]]) / (reps[1] - reps[0])
 
     per2 = slope2(lambda h, l: layer2(h, l)) / K
+    perkp = slope2(lambda h, l: layerkp(h, l)) / K
+    perkp2 = slope2(lambda h, l: layerkp2(h, l)) / K
     metrics.record("bitonic_layer_1w_ms", round(per1 * 1e3, 4), "ms")
     metrics.record("bitonic_layer_2w_ms", round(per2 * 1e3, 4), "ms")
     metrics.record("bitonic_layer_2w_ratio", round(per2 / per1, 3), "x")
+    metrics.record("bitonic_layer_kp_ms", round(perkp * 1e3, 4), "ms")
+    metrics.record("bitonic_layer_kp_ratio", round(perkp / per1, 3), "x")
+    metrics.record("bitonic_layer_kp2_ms", round(perkp2 * 1e3, 4), "ms")
+    metrics.record("bitonic_layer_kp2_ratio", round(perkp2 / per1, 3), "x")
     print(f"{'bitonic_layer_1w':22s} {per1*1e3:10.4f}")
     print(f"{'bitonic_layer_2w':22s} {per2*1e3:10.4f}   ratio {per2/per1:.2f}x "
           f"(compare against lax.sort's own 2-word penalty — BASELINE.md)")
+    print(f"{'bitonic_layer_kp':22s} {perkp*1e3:10.4f}   ratio {perkp/per1:.2f}x "
+          f"(key+payload: the MSD-hybrid core primitive)")
+    print(f"{'bitonic_layer_kp2':22s} {perkp2*1e3:10.4f}   ratio {perkp2/per1:.2f}x "
+          f"(key+payload via out_k==k routing)")
 
     flat = x.reshape(-1)
     def slope_flat(fn, reps=(1, 3)):

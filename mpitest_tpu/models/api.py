@@ -243,6 +243,186 @@ def _f64_host_input(x, tracer):
     return np.asarray(x)
 
 
+def _host_hi_dup_sniff(hi: np.ndarray) -> bool:
+    """Host twin of the hi-duplication sniff (same ~1024-key sample)."""
+    n = hi.size
+    s = min(1024, n)
+    idx = np.linspace(0, n - 1, s).astype(np.int64)
+    samp = np.sort(hi[idx])
+    return bool(np.any(samp[1:] == samp[:-1]))
+
+
+@lru_cache(maxsize=4)
+def _compile_pair_sort(impl: str):
+    interpret = impl == "bitonic_interpret"
+
+    def f(hi, lo):
+        return kernels.sort_two_words_bitonic(hi, lo, interpret=interpret)
+
+    return jax.jit(f)
+
+
+#: Engine codes returned by the fused device program (scalar, one fetch).
+_PAIR_CODES = {0: "constant", 1: "bitonic_1w1", 2: "bitonic_1w0",
+               3: "lax", 4: "bitonic_pair", 5: "bitonic_pair+lax_fallback"}
+
+
+@lru_cache(maxsize=8)
+def _compile_pair_fused(dtype_name: str, impl: str):
+    """ONE-dispatch device program for 2-word device-resident local
+    sorts: encode + range/dup planning + a ``lax.cond`` tree selecting
+    constant-word 1-word engine / variadic ``lax.sort`` / pair engine
+    (with its residual fallback folded in as a nested cond) — every
+    branch returns the same shapes, so the whole adaptive decision runs
+    on device.  Rationale: each extra dispatch costs ~0.15-0.2 s over
+    this image's tunnel, which is larger than the pair engine's entire
+    kernel-level win at 2^27 — the host-orchestrated version measured
+    SLOWER end-to-end than the single-jit lax path despite a 1.4x
+    faster device sort."""
+    from jax import lax as jlax
+
+    codec = codec_for(np.dtype(dtype_name))
+    interpret = impl == "bitonic_interpret"
+
+    def lax2w(hi, lo):
+        out = jlax.sort([hi, lo], num_keys=2, is_stable=False)
+        return out[0], out[1]
+
+    def one_w(w):
+        return kernels.local_sort((w,), engine=impl)[0]
+
+    def f(x):
+        hi, lo = codec.encode_jax(x.reshape(-1))
+        d0 = jnp.min(hi) ^ jnp.max(hi)
+        d1 = jnp.min(lo) ^ jnp.max(lo)
+        n = hi.shape[0]
+        s = min(1024, n)
+        if s > 1:
+            stride = max(1, (n - 1) // (s - 1))
+            s_eff = (n - 1) // stride + 1
+            start = (n - 1) - (s_eff - 1) * stride
+            samp = jlax.sort(
+                [jlax.slice(hi, (start,),
+                            (start + (s_eff - 1) * stride + 1,), (stride,))],
+                num_keys=1, is_stable=False)[0]
+            dup = jnp.any(samp[1:] == samp[:-1])
+        else:
+            dup = jnp.zeros((), bool)
+
+        def b_both(h, l):   # both words constant: already sorted
+            return h, l, jnp.int32(0)
+
+        def b_hic(h, l):    # hi constant: 1-word engine on lo
+            return h, one_w(l), jnp.int32(1)
+
+        def b_loc(h, l):    # lo constant: 1-word engine on hi
+            return one_w(h), l, jnp.int32(2)
+
+        def b_lax(h, l):    # sniffed hi duplication: straight to lax
+            hs, ls = lax2w(h, l)
+            return hs, ls, jnp.int32(3)
+
+        def b_pair(h, l):
+            hs, ls, bad = kernels.sort_two_words_bitonic(
+                h, l, interpret=interpret)
+            hs, ls = jlax.cond(bad, lax2w, lambda a, b: (hs, ls), h, l)
+            return hs, ls, jnp.where(bad, jnp.int32(5), jnp.int32(4))
+
+        def b_var(h, l):    # both words vary: sniff decides
+            return jlax.cond(dup, b_lax, b_pair, h, l)
+
+        return jlax.cond(
+            d0 == jnp.uint32(0),
+            lambda a, b: jlax.cond(d1 == jnp.uint32(0), b_both, b_hic, a, b),
+            lambda a, b: jlax.cond(d1 == jnp.uint32(0), b_loc, b_var, a, b),
+            hi, lo)
+
+    return jax.jit(f)
+
+
+def _local_pair_sort(x, is_device, codec, dtype, mesh, tracer):
+    """Single-device 64-bit sort orchestration — the MSD-hybrid structure
+    (VERDICT r3 #1), adaptive like the skew fallback:
+
+    1. constant-word shortcut: a word with zero range never needs
+       sorting — narrow-range int64 (values inside one 32-bit window,
+       common in practice) collapses to the plain 1-word bitonic engine
+       on the other word, ~2x faster again than the pair engine.
+    2. hi-duplication sniff: heavy duplication would leave equal-hi runs
+       longer than the pair engine's fixed run fix-up depth — route to
+       the variadic ``lax.sort`` up front (no wasted phase).
+    3. pair engine (``kernels.sort_two_words_bitonic``): key+payload
+       bitonic by hi + segmented odd-even run fix-up.  The residual flag
+       (runs the sniff missed) falls back to ``lax.sort`` — correctness
+       never depends on the sniff.
+
+    Returns the sorted device word tuple.
+    """
+    engine = _local_engine()
+    impl = _bitonic_impl()
+    if is_device and _f64_known_broken(_device_platform(x), dtype, codec):
+        x, is_device = _f64_host_input(x, tracer), False
+    if is_device:
+        # Device-resident input: the whole adaptive tree runs in ONE
+        # fused dispatch (see _compile_pair_fused) — host-side branching
+        # would cost a tunnel round-trip per decision.
+        try:
+            with tracer.phase("sort"):
+                hi_s, lo_s, code = _compile_pair_fused(dtype.name, impl)(x)
+                code = int(code)
+        except jax.errors.JaxRuntimeError as e:
+            if not _is_f64_lowering_gap(e, dtype, codec, _device_platform(x)):
+                raise
+            x, is_device = _f64_host_input(x, tracer), False
+        else:
+            tracer.counters["local_engine"] = _PAIR_CODES[code]
+            if code == 3:
+                tracer.count("pair_dup_reroute", 1)
+            elif code == 5:
+                tracer.verbose(
+                    "pair engine left residual runs (hi duplication the "
+                    "sniff missed); lax fallback ran on device")
+                tracer.count("pair_residual_fallback", 1)
+            return (hi_s, lo_s)
+    if not is_device:
+        with tracer.phase("encode"):
+            words_np = codec.encode(np.asarray(x).reshape(-1))
+            rng = np.array([words_np[0].min(), words_np[0].max(),
+                            words_np[1].min(), words_np[1].max()])
+            dup = _host_hi_dup_sniff(words_np[0])
+        with tracer.phase("device_put"):
+            dev = mesh.devices.flat[0]
+            words = tuple(jax.device_put(w, dev) for w in words_np)
+    diffs = (int(rng[0]) ^ int(rng[1]), int(rng[2]) ^ int(rng[3]))
+    if diffs == (0, 0):  # all keys identical: already sorted
+        tracer.counters["local_engine"] = "constant"
+        return words
+    for const_w, sort_w in ((0, 1), (1, 0)):
+        if diffs[const_w] == 0:
+            # the constant word never moves; 1-word engine on the other
+            tracer.counters["local_engine"] = f"bitonic_1w{sort_w}"
+            with tracer.phase("sort"):
+                s_out = _compile_local(1, engine)(words[sort_w])[0]
+            return (words[0], s_out) if sort_w == 1 else (s_out, words[1])
+    if dup:
+        tracer.counters["local_engine"] = "lax"
+        tracer.count("pair_dup_reroute", 1)
+        with tracer.phase("sort"):
+            return _compile_local(2, "lax")(*words)
+    tracer.counters["local_engine"] = "bitonic_pair"
+    with tracer.phase("sort"):
+        hi_s, lo_s, bad = _compile_pair_sort(impl)(*words)
+        bad = bool(bad)
+    if bad:
+        tracer.verbose(
+            "pair engine left residual runs (hi duplication the sniff "
+            "missed); falling back to lax.sort")
+        tracer.count("pair_residual_fallback", 1)
+        with tracer.phase("sort"):
+            return _compile_local(2, "lax")(*words)
+    return (hi_s, lo_s)
+
+
 _LOCAL_ENGINES = ("auto", "bitonic", "lax")
 
 
@@ -611,8 +791,21 @@ def sort(
     n = max(1, math.ceil(N / n_ranks))
 
     if n_ranks == 1 and algorithm in ("radix", "sample"):
+        engine = _local_engine()
+        if (codec.n_words == 2 and engine != "lax"
+                and N >= (1 << bitonic.MIN_SORT_LOG2)
+                and (engine == "bitonic" or jax.default_backend() == "tpu")):
+            # 64-bit local path: the adaptive pair-engine orchestration
+            # (constant-word shortcut / dup sniff / pair bitonic + run
+            # fix-up / lax fallback) — see _local_pair_sort.
+            out = _local_pair_sort(x, is_device, codec, dtype, mesh, tracer)
+            res = DistributedSortResult(out, N, dtype)
+            if return_result:
+                return res
+            with tracer.phase("decode"):
+                return res.to_numpy()
         tracer.counters["local_engine"] = (
-            "bitonic" if _use_bitonic(_local_engine(), codec.n_words, N)
+            "bitonic" if _use_bitonic(engine, codec.n_words, N)
             else "lax"
         )
         if is_device and _f64_known_broken(_device_platform(x), dtype, codec):

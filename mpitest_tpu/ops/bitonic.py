@@ -49,12 +49,18 @@ sorted bytes ``lax.sort`` or ``qsort`` would produce (reference output
 contract: ``mpi_sample_sort.c:203-205``).
 
 Scope: one-word uint32 keys (the encoded form of int32/uint32/float32 —
-see ``ops/keys.py``), key-only (no payload): the flagship single-device
-path and the per-shard sorts of the distributed sample sort
-(``kernels.local_sort(engine="bitonic")``).  Multi-word keys and the
-radix per-pass variadic sorts keep ``lax.sort`` — BASELINE.md's design
-study shows the measured 2-word margin does not pay for a second kernel
-family.
+see ``ops/keys.py``) for the key-only engine, PLUS a key+payload twin
+(round 4) that sorts ``(key, payload)`` uint32 pairs by the key plane —
+the core of the 64-bit MSD-hybrid local sort (``kernels`` /
+``models/api.py``): hi word as key, lo word as payload, equal-hi runs
+fixed by a short segmented pass afterwards.  The pair layer routes the
+payload from the key *result* (``out_k == k``: low side keeps its
+payload iff ``k <= partner``, high iff ``k >= partner``, ties keep own
+on both sides — a consistent no-swap), which measures **1.98x** the
+1-word layer on v5e where the lexicographic 2-word form measures 4.8x
+(``bench/kernel_probes.py`` ``bitonic_layer_kp2``) — the payload plane
+costs its bandwidth and nothing else.  The radix per-pass variadic
+sorts keep ``lax.sort``.
 """
 
 from __future__ import annotations
@@ -63,6 +69,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
@@ -75,8 +82,26 @@ LANES_LOG2 = 7
 BLOCK_LOG2 = 16
 #: below this the padded network does not beat lax.sort's fixed costs.
 MIN_SORT_LOG2 = 13
+#: pair-engine shape: two planes double the in-VMEM footprint.  Keeping
+#: the 2^16 block (shrinking it to 2^15 measured 2.2x SLOWER on the
+#: whole network — extra stages + HBM visits dwarf everything) and
+#: instead halving the merge/cross transfer groups to 4 blocks keeps the
+#: 8-member pair merge's 25.6 MiB scoped-vmem demand (measured, over the
+#: 16 MiB limit) at ~13 MiB.
+PAIR_BLOCK_LOG2 = 16
+_PAIR_CROSS_GROUP = 4      # blocks per pair cross-layer transfer group
+_PAIR_MERGE_BITS = 2       # cross bits fused into the pair merge tail
 #: blocks per cross-layer transfer group (see ``_cross_kernel``).
 _CROSS_GROUP = 8
+
+#: Index-map constants pinned to int32: under jax_enable_x64 (the
+#: device-resident 64-bit path) Python-int literals in index maps
+#: weak-promote to i64, which Mosaic's block-map functions reject.
+_Z = np.int32(0)
+
+
+def _zmap(i, *_):
+    return (i, _Z, _Z)
 
 
 def _asc_layer(x, lj: int, t_layout: bool = False):
@@ -109,8 +134,8 @@ def _asc_layer(x, lj: int, t_layout: bool = False):
     else:
         axis, shift, log = 0, 1 << (lj - LANES_LOG2), lj - LANES_LOG2
     size = x.shape[axis]
-    fwd = pltpu.roll(x, size - shift, axis)  # out[i] = in[i + shift]
-    bwd = pltpu.roll(x, shift, axis)         # out[i] = in[i - shift]
+    fwd = pltpu.roll(x, np.int32(size - shift), axis)  # out[i] = in[i + shift]
+    bwd = pltpu.roll(x, np.int32(shift), axis)         # out[i] = in[i - shift]
     idx = lax.broadcasted_iota(jnp.int32, x.shape, axis)
     low = ((idx >> log) & 1) == 0            # bit clear -> partner above
     return jnp.where(low, jnp.minimum(x, fwd), jnp.maximum(x, bwd))
@@ -274,7 +299,7 @@ def _merge_kernel(s_ref, x_ref, o_ref, *, n_members: int, s_rows: int,
 
 @functools.lru_cache(maxsize=16)
 def _compile_block_sort(nblk: int, s_rows: int, b_log2: int, interpret: bool):
-    spec = pl.BlockSpec((1, s_rows, LANES), lambda i: (i, 0, 0),
+    spec = pl.BlockSpec((1, s_rows, LANES), _zmap,
                         memory_space=pltpu.VMEM)
     return pl.pallas_call(
         functools.partial(_block_sort_kernel, s_rows=s_rows, b_log2=b_log2),
@@ -304,7 +329,7 @@ def _compile_cross(nblk: int, s_rows: int, interpret: bool):
             mask = (1 << sjg) - 1
             glo = ((q & ~mask) << 1) | (q & mask)
             pick = side if side is not None else r
-            return (glo | (pick << sjg), 0, 0)
+            return (glo | (pick << sjg), _Z, _Z)
         return f
 
     ngroups = nblk // _CROSS_GROUP
@@ -327,7 +352,7 @@ def _compile_cross(nblk: int, s_rows: int, interpret: bool):
 @functools.lru_cache(maxsize=16)
 def _compile_merge(n_members: int, nblk: int, s_rows: int, b_log2: int,
                    interpret: bool):
-    spec = pl.BlockSpec((n_members, s_rows, LANES), lambda g, s: (g, 0, 0),
+    spec = pl.BlockSpec((n_members, s_rows, LANES), lambda g, s: (g, _Z, _Z),
                         memory_space=pltpu.VMEM)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -383,6 +408,322 @@ def sort_padded(x, n_pow2: int, b_log2: int, interpret: bool = False):
         xb = merge(jnp.asarray([m], jnp.int32), xb)
     out = xb.reshape(-1)
     return lax.bitcast_convert_type(out, jnp.uint32) ^ jnp.uint32(0x80000000)
+
+
+# ------------------------------------------------------- key+payload twin
+
+
+def _asc_layer_pair(k, p, lj: int, t_layout: bool = False):
+    """Pair compare-exchange at distance ``2^lj``: the key plane runs the
+    exact 6-op ascending form of :func:`_asc_layer`; the payload plane is
+    routed by ``out_k == k`` (see module docstring — measured 1.98x the
+    1-word layer, vs 4.8x for a lexicographic 2-word compare)."""
+    if t_layout:
+        assert lj < LANES_LOG2
+        axis, shift, log = 0, 1 << lj, lj
+    elif lj < LANES_LOG2:
+        axis, shift, log = 1, 1 << lj, lj
+    else:
+        axis, shift, log = 0, 1 << (lj - LANES_LOG2), lj - LANES_LOG2
+    size = k.shape[axis]
+    fk = pltpu.roll(k, np.int32(size - shift), axis)
+    bk = pltpu.roll(k, np.int32(shift), axis)
+    fp = pltpu.roll(p, np.int32(size - shift), axis)
+    bp = pltpu.roll(p, np.int32(shift), axis)
+    idx = lax.broadcasted_iota(jnp.int32, k.shape, axis)
+    low = ((idx >> log) & 1) == 0
+    out_k = jnp.where(low, jnp.minimum(k, fk), jnp.maximum(k, bk))
+    out_p = jnp.where(out_k == k, p, jnp.where(low, fp, bp))
+    return out_k, out_p
+
+
+def _sweep_pair(k, p, b_log2: int):
+    """Pair twin of :func:`_sweep`: the trailing in-block sweep with the
+    ``lj < 7`` tail on the transposed planes."""
+    for lj in range(b_log2 - 1, LANES_LOG2 - 1, -1):
+        k, p = _asc_layer_pair(k, p, lj)
+    kt, pt = k.T, p.T
+    for lj in range(LANES_LOG2 - 1, -1, -1):
+        kt, pt = _asc_layer_pair(kt, pt, lj, t_layout=True)
+    return kt.T, pt.T
+
+
+def _block_sort_pair_kernel(k_ref, p_ref, ok_ref, op_ref, *, s_rows: int,
+                            b_log2: int):
+    """Pair twin of :func:`_block_sort_kernel`.  Flip bookkeeping touches
+    the KEY plane only — the payload is never compared, so descending
+    segments keep their payloads as-is and ``out_k == k`` routing stays
+    exact on the flipped keys (equality is flip-invariant)."""
+    blk = pl.program_id(0)
+
+    def transition(k, m, t_layout):
+        delta = _flat_bit(k.shape, m, t_layout)
+        if m + 1 < b_log2:
+            delta = delta ^ _flat_bit(k.shape, m + 1, t_layout)
+        elif m + 1 == b_log2:
+            delta = delta ^ ((blk & 1) == 1)
+        else:
+            delta = (blk & 1) == 1
+            return jnp.where(delta, ~k, k)
+        return jnp.where(delta, ~k, k)
+
+    kt, pt = k_ref[0].T, p_ref[0].T
+    kt = jnp.where(_flat_bit(kt.shape, 1, True), ~kt, kt)
+    for m in range(1, LANES_LOG2 + 1):
+        for lj in range(m - 1, -1, -1):
+            kt, pt = _asc_layer_pair(kt, pt, lj, t_layout=True)
+        kt = transition(kt, m, True)
+    k, p = kt.T, pt.T
+    for m in range(LANES_LOG2 + 1, b_log2 + 1):
+        for lj in range(m - 1, LANES_LOG2 - 1, -1):
+            k, p = _asc_layer_pair(k, p, lj)
+        kt, pt = k.T, p.T
+        for lj in range(LANES_LOG2 - 1, -1, -1):
+            kt, pt = _asc_layer_pair(kt, pt, lj, t_layout=True)
+        k, p = kt.T, pt.T
+        k = transition(k, m, False)
+    ok_ref[0], op_ref[0] = k, p
+
+
+def _cross_pair_kernel(s_ref, kl_ref, kh_ref, pl_ref, ph_ref,
+                       ok_ref, op_ref):
+    """Pair twin of :func:`_cross_kernel` (group = ``_PAIR_CROSS_GROUP``
+    blocks): key min/max as before; each side's payload follows its key
+    result (``lo == kl`` / ``hi == kh`` — ties route both payloads to
+    their own sides, a consistent no-swap, so the pair multiset is
+    preserved exactly)."""
+    sjg, sm = s_ref[0], s_ref[1]
+    q = pl.program_id(0)
+    r = pl.program_id(1)
+    mask = (1 << sjg) - 1
+    glo = ((q & ~mask) << 1) | (q & mask)
+    blo = glo * _PAIR_CROSS_GROUP
+    take_min_low = ((blo >> sm) & 1) == 0
+    kl, kh = kl_ref[:], kh_ref[:]
+    lo = jnp.minimum(kl, kh)
+    hi = jnp.maximum(kl, kh)
+    p_lo = jnp.where(lo == kl, pl_ref[:], ph_ref[:])
+    p_hi = jnp.where(hi == kh, ph_ref[:], pl_ref[:])
+    side = take_min_low ^ (r == 1)
+    ok_ref[:] = jnp.where(side, lo, hi)
+    op_ref[:] = jnp.where(side, p_lo, p_hi)
+
+
+def _merge_pair_kernel(s_ref, k_ref, p_ref, ok_ref, op_ref, *,
+                       n_members: int, s_rows: int, b_log2: int):
+    """Pair twin of :func:`_merge_kernel` (fused cross tail + sweep)."""
+    m = s_ref[0]
+    g = pl.program_id(0)
+    sign_shift = m - b_log2
+    bids = [g * n_members + i for i in range(n_members)]
+    desc = [((bid >> sign_shift) & 1) == 1 for bid in bids]
+    ks = [jnp.where(desc[i], ~k_ref[i], k_ref[i]) for i in range(n_members)]
+    ps = [p_ref[i] for i in range(n_members)]
+
+    c = n_members.bit_length() - 1
+    for kbit in range(c - 1, -1, -1):
+        for i in range(n_members):
+            if (i >> kbit) & 1:
+                continue
+            j = i | (1 << kbit)
+            lo = jnp.minimum(ks[i], ks[j])
+            hi = jnp.maximum(ks[i], ks[j])
+            p_lo = jnp.where(lo == ks[i], ps[i], ps[j])
+            p_hi = jnp.where(hi == ks[j], ps[j], ps[i])
+            ks[i], ks[j] = lo, hi
+            ps[i], ps[j] = p_lo, p_hi
+
+    for i in range(n_members):
+        k, p = _sweep_pair(ks[i], ps[i], b_log2)
+        ok_ref[i] = jnp.where(desc[i], ~k, k)
+        op_ref[i] = p
+
+
+@functools.lru_cache(maxsize=16)
+def _compile_block_sort_pair(nblk: int, s_rows: int, b_log2: int,
+                             interpret: bool):
+    spec = pl.BlockSpec((1, s_rows, LANES), _zmap,
+                        memory_space=pltpu.VMEM)
+    shape = jax.ShapeDtypeStruct((nblk, s_rows, LANES), jnp.int32)
+    return pl.pallas_call(
+        functools.partial(_block_sort_pair_kernel, s_rows=s_rows,
+                          b_log2=b_log2),
+        out_shape=[shape, shape],
+        grid=(nblk,),
+        in_specs=[spec, spec],
+        out_specs=[spec, spec],
+        interpret=interpret,
+    )
+
+
+@functools.lru_cache(maxsize=16)
+def _compile_cross_pair(nblk: int, s_rows: int, interpret: bool):
+    def pair_map(side):
+        def f(q, r, s_ref):
+            sjg = s_ref[0]
+            mask = (1 << sjg) - 1
+            glo = ((q & ~mask) << 1) | (q & mask)
+            pick = side if side is not None else r
+            return (glo | (pick << sjg), _Z, _Z)
+        return f
+
+    ngroups = nblk // _PAIR_CROSS_GROUP
+    gspec = lambda m: pl.BlockSpec((_PAIR_CROSS_GROUP, s_rows, LANES), m,
+                                   memory_space=pltpu.VMEM)
+    shape = jax.ShapeDtypeStruct((nblk, s_rows, LANES), jnp.int32)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(ngroups // 2, 2),
+        in_specs=[gspec(pair_map(0)), gspec(pair_map(1)),
+                  gspec(pair_map(0)), gspec(pair_map(1))],
+        out_specs=[gspec(pair_map(None)), gspec(pair_map(None))],
+    )
+    return pl.pallas_call(
+        _cross_pair_kernel,
+        out_shape=[shape, shape],
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )
+
+
+@functools.lru_cache(maxsize=16)
+def _compile_merge_pair(n_members: int, nblk: int, s_rows: int, b_log2: int,
+                        interpret: bool):
+    spec = pl.BlockSpec((n_members, s_rows, LANES), lambda g, s: (g, _Z, _Z),
+                        memory_space=pltpu.VMEM)
+    shape = jax.ShapeDtypeStruct((nblk, s_rows, LANES), jnp.int32)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nblk // n_members,),
+        in_specs=[spec, spec],
+        out_specs=[spec, spec],
+    )
+    return pl.pallas_call(
+        functools.partial(_merge_pair_kernel, n_members=n_members,
+                          s_rows=s_rows, b_log2=b_log2),
+        out_shape=[shape, shape],
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )
+
+
+def sort_pairs_padded(k, p, n_pow2: int, b_log2: int,
+                      interpret: bool = False):
+    """Bitonic-sort uint32 ``(k, p)`` pairs by the KEY plane only.
+
+    Same network as :func:`sort_padded`; the payload plane rides every
+    exchange via ``out_k == k`` routing.  Equal keys keep their own
+    payloads at every comparator, so the output payload order within an
+    equal-key run is an arbitrary (but deterministic) permutation — the
+    64-bit caller fixes runs afterwards (``kernels.sort_two_words``).
+
+    Returns ``(k_sorted, p_permuted)``, both flat uint32 [n_pow2].
+    """
+    t = n_pow2.bit_length() - 1
+    assert 1 << t == n_pow2 and t >= b_log2
+    s_rows = 1 << (b_log2 - LANES_LOG2)
+    nblk = n_pow2 >> b_log2
+    k = lax.bitcast_convert_type(k ^ jnp.uint32(0x80000000), jnp.int32)
+    p = lax.bitcast_convert_type(p, jnp.int32)  # payload: bits only
+    kb = k.reshape(nblk, s_rows, LANES)
+    pb = p.reshape(nblk, s_rows, LANES)
+
+    kb, pb = _compile_block_sort_pair(nblk, s_rows, b_log2, interpret)(kb, pb)
+
+    tail = _PAIR_MERGE_BITS  # log2(_PAIR_CROSS_GROUP): merge's cross share
+    cross = (_compile_cross_pair(nblk, s_rows, interpret)
+             if t > b_log2 + tail else None)
+
+    for m in range(b_log2 + 1, t + 1):
+        nbits = m - b_log2
+        for sj in range(nbits - 1, tail - 1, -1):
+            kb, pb = cross(jnp.asarray([sj - tail, nbits], jnp.int32),
+                           kb, kb, pb, pb)
+        g_final = 1 << min(nbits, tail)
+        merge = _compile_merge_pair(g_final, nblk, s_rows, b_log2, interpret)
+        kb, pb = merge(jnp.asarray([m], jnp.int32), kb, pb)
+    k_out = lax.bitcast_convert_type(kb.reshape(-1), jnp.uint32)
+    p_out = lax.bitcast_convert_type(pb.reshape(-1), jnp.uint32)
+    return k_out ^ jnp.uint32(0x80000000), p_out
+
+
+def _fix_runs_pair_kernel(k_ref, p_ref, o_ref, *, passes: int, s_rows: int):
+    """In-VMEM segment-masked odd-even transposition: ``passes`` passes
+    of lo-exchange within equal-hi runs, per block.  The XLA formulation
+    of the same passes costs ~6 ms/pass at 2^26 (measured — the
+    shift-by-one copies stream the whole plane from HBM every pass);
+    here all passes run on one VMEM visit.
+
+    Neighbor construction in the natural ``[S, 128]`` layout: flat
+    ``i+1`` is ``lane+1`` with a row carry at lane 127 — one cheap
+    sublane roll plus one lane roll for the carry column, selected by
+    the lane mask.  The block's last element pairs with nothing (its
+    neighbor wraps); runs crossing block boundaries are finished by the
+    XLA boundary-strip pass (``kernels._fix_boundary``).
+
+    The lo plane is compared in the sign-flipped int32 domain (unsigned
+    order; Mosaic has no unsigned vector compare) and unflipped on the
+    way out.  hi is compared for equality only — flip-invariant.
+    """
+    hi = k_ref[0]
+    lo = p_ref[0] ^ jnp.int32(-(2**31))
+    lane = lax.broadcasted_iota(jnp.int32, hi.shape, 1)
+    row = lax.broadcasted_iota(jnp.int32, hi.shape, 0)
+    at_carry = lane == (LANES - 1)
+    at_zero = lane == 0
+    last = at_carry & (row == s_rows - 1)
+
+    def nxt(v):
+        up = pltpu.roll(v, np.int32(LANES - 1), 1)
+        upc = pltpu.roll(up, np.int32(s_rows - 1), 0)
+        return jnp.where(at_carry, upc, up)
+
+    def prv(v):
+        dn = pltpu.roll(v, np.int32(1), 1)
+        dnc = pltpu.roll(dn, np.int32(1), 0)
+        return jnp.where(at_zero, dnc, dn)
+
+    same = (hi == nxt(hi)) & ~last
+    par = lane & 1  # flat parity = lane bit 0
+    for t in range(passes):
+        nb = nxt(lo)
+        act = same & (par == (t & 1)) & (lo > nb)
+        a32 = act.astype(jnp.int32)
+        # element 0's "previous" wraps to the block's last element,
+        # which is always inactive -> act 0 -> safe
+        pv_on = prv(a32) == 1
+        lo = jnp.where(act, nb, jnp.where(pv_on, prv(lo), lo))
+    o_ref[0] = lo ^ jnp.int32(-(2**31))
+
+
+@functools.lru_cache(maxsize=16)
+def _compile_fix_runs(nblk: int, s_rows: int, passes: int, interpret: bool):
+    spec = pl.BlockSpec((1, s_rows, LANES), _zmap,
+                        memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        functools.partial(_fix_runs_pair_kernel, passes=passes,
+                          s_rows=s_rows),
+        out_shape=jax.ShapeDtypeStruct((nblk, s_rows, LANES), jnp.int32),
+        grid=(nblk,),
+        in_specs=[spec, spec],
+        out_specs=spec,
+        interpret=interpret,
+    )
+
+
+def fix_runs_pairs(hi, lo, passes: int, b_log2: int,
+                   interpret: bool = False):
+    """Sort ``lo`` within equal-``hi`` runs of length <= ``passes``
+    (both flat uint32, ``hi`` sorted, power-of-two length): the in-VMEM
+    per-block kernel above; cross-block runs are the caller's
+    boundary-strip job."""
+    n = hi.shape[0]
+    s_rows = 1 << (b_log2 - LANES_LOG2)
+    nblk = n >> b_log2
+    kb = lax.bitcast_convert_type(hi, jnp.int32).reshape(nblk, s_rows, LANES)
+    pb = lax.bitcast_convert_type(lo, jnp.int32).reshape(nblk, s_rows, LANES)
+    out = _compile_fix_runs(nblk, s_rows, passes, interpret)(kb, pb)
+    return lax.bitcast_convert_type(out.reshape(-1), jnp.uint32)
 
 
 def bitonic_sort_u32(x, interpret: bool = False):

@@ -39,6 +39,72 @@ def test_sort_padded(n_log2, b_log2):
     np.testing.assert_array_equal(np.asarray(out), np.sort(x))
 
 
+def _check_pairs(k, p, ks, ps):
+    """Pair-engine contract: keys exactly sorted; the (key, payload)
+    PAIR multiset is preserved (payloads may be permuted within an
+    equal-key run — that is the documented contract; the 64-bit caller
+    fixes runs afterwards)."""
+    np.testing.assert_array_equal(ks, np.sort(k))
+    got = np.stack([ks, ps], 1)
+    want = np.stack([k, p], 1)
+    np.testing.assert_array_equal(
+        got[np.lexsort((got[:, 1], got[:, 0]))],
+        want[np.lexsort((want[:, 1], want[:, 0]))],
+    )
+
+
+@pytest.mark.parametrize(
+    "n_log2,b_log2,span",
+    [
+        (10, 10, 32),    # single block, heavy duplication
+        (13, 13, 1 << 32),
+        (13, 10, 256),   # merge stages, duplicated keys
+        (15, 11, 1 << 32),   # one grouped cross layer
+        (16, 11, 64),    # cross layers at two distances + heavy dups
+    ],
+)
+def test_sort_pairs_padded(n_log2, b_log2, span):
+    rng = np.random.default_rng(n_log2 * 37 + b_log2)
+    n = 1 << n_log2
+    k = rng.integers(0, span, n).astype(np.uint32)
+    p = rng.integers(0, 1 << 32, n, dtype=np.uint32)
+    ks, ps = bitonic.sort_pairs_padded(jnp.asarray(k), jnp.asarray(p),
+                                       n, b_log2, interpret=True)
+    _check_pairs(k, p, np.asarray(ks), np.asarray(ps))
+
+
+def test_fix_runs_pairs_kernel_and_boundary():
+    """The in-VMEM run-fix kernel + XLA boundary strip must sort lo
+    within every equal-hi run of length <= passes — including runs that
+    CROSS block boundaries — matching the unique per-run-sorted answer
+    (and hence the reference XLA formulation, kernels._fix_runs_oe)."""
+    from mpitest_tpu.ops import kernels
+
+    rng = np.random.default_rng(11)
+    n, b_log2, passes = 1 << 13, 10, 8
+    # runs of length 1..8 over strictly increasing hi values: many runs
+    # straddle the 2^10 block boundaries
+    lens = []
+    total = 0
+    while total < n:
+        l = int(rng.integers(1, passes + 1))
+        l = min(l, n - total)
+        lens.append(l)
+        total += l
+    hi = np.repeat(np.arange(len(lens), dtype=np.uint32) * 11 + 3, lens)
+    lo = rng.integers(0, 1 << 32, n, dtype=np.uint64).astype(np.uint32)
+
+    got = bitonic.fix_runs_pairs(jnp.asarray(hi), jnp.asarray(lo), passes,
+                                 b_log2, interpret=True)
+    got = kernels._fix_boundary(jnp.asarray(hi), got, passes, 1 << b_log2)
+    want = lo.copy()
+    start = 0
+    for l in lens:
+        want[start:start + l] = np.sort(want[start:start + l])
+        start += l
+    np.testing.assert_array_equal(np.asarray(got), want)
+
+
 @pytest.mark.parametrize("pattern", ["random", "sorted", "reversed",
                                      "all-equal", "few-distinct"])
 def test_patterns(pattern):

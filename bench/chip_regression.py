@@ -105,6 +105,54 @@ def main() -> int:
     row.update(bitonic_ms=round(bit_ms, 1), lax_sort_ms=round(lax_ms, 1),
                bitonic_speedup=round(ratio, 2))
 
+    # ---- 1b. 64-bit pair engine vs variadic lax.sort: bit-equal + slope ----
+    from mpitest_tpu.ops import kernels
+
+    lo2 = jnp.asarray(rng.integers(0, 2**32, n, dtype=np.uint64)
+                      .astype(np.uint32))
+
+    @jax.jit
+    def pair_agree(h, l):
+        hs, ls, bad = kernels.sort_two_words_bitonic(h, l)
+        ref = jax.lax.sort([h, l], num_keys=2, is_stable=False)
+        return jnp.all(hs == ref[0]) & jnp.all(ls == ref[1]) & ~bad
+
+    pagree = bool(jax.device_get(pair_agree(x, lo2)))
+    print(f"pair engine == lax.sort 2w @2^{log2n}: "
+          f"{'OK' if pagree else 'FAIL'}", flush=True)
+    row["pair_matches_lax"] = pagree
+    ok &= pagree
+
+    def slope2(fn, reps=(1, 3), tries=3):
+        out = {}
+        for r in reps:
+            @jax.jit
+            def g(h, l, r=r):
+                for _ in range(r):
+                    h, l = fn(h, l)
+                return h, l
+            y = g(x, lo2)
+            jax.device_get(y[0][:1])
+            ts = []
+            for _ in range(tries):
+                t = time.perf_counter()
+                y = g(x, lo2)
+                jax.device_get(y[0][:1])
+                ts.append(time.perf_counter() - t)
+            out[r] = min(ts)
+        return (out[reps[1]] - out[reps[0]]) / (reps[1] - reps[0])
+
+    pair_ms = slope2(
+        lambda h, l: kernels.sort_two_words_bitonic(h, l)[:2]) * 1e3
+    lax2_ms = slope2(
+        lambda h, l: tuple(jax.lax.sort([h, l], num_keys=2,
+                                        is_stable=False))) * 1e3
+    pratio = lax2_ms / pair_ms if pair_ms > 0 else float("nan")
+    print(f"pair {pair_ms:.1f} ms  lax.sort-2w {lax2_ms:.1f} ms  "
+          f"ratio {pratio:.2f}x (regression band: 1.25-1.45x)", flush=True)
+    row.update(pair_ms=round(pair_ms, 1), lax_sort_2w_ms=round(lax2_ms, 1),
+               pair_speedup=round(pratio, 2))
+
     # ---- 2. segment_pack vs numpy on ragged segments ----
     P = 8
     nd = 1 << 20

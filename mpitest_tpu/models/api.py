@@ -438,8 +438,8 @@ def _local_engine() -> str:
 
 
 def _use_bitonic(engine: str, n_words: int, n: int) -> bool:
-    if n_words != 1:
-        return False  # multi-word keys keep the variadic lax.sort
+    if n_words > 2:
+        return False  # wider keys keep the variadic lax.sort
     if engine == "bitonic":
         return True
     return engine == "auto" and jax.default_backend() == "tpu" and (

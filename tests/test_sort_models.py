@@ -306,6 +306,23 @@ def test_sample_spmd_bitonic_engine(mesh8, rng, monkeypatch):
     np.testing.assert_array_equal(got, np.sort(x))
 
 
+def test_sample_spmd_pair_engine_64bit(mesh8, rng, monkeypatch):
+    """The distributed sample sort's per-shard sorts on the 64-bit PAIR
+    engine under shard_map (interpret mode on the CPU mesh): the
+    residual fallback is an on-device cond here — no host orchestration
+    exists inside the SPMD program — and the output must still be exact
+    bytes."""
+    from mpitest_tpu.ops import bitonic
+
+    monkeypatch.setenv("SORT_LOCAL_ENGINE", "bitonic")
+    monkeypatch.setattr(bitonic, "MIN_SORT_LOG2", 8)
+    monkeypatch.setattr(bitonic, "BLOCK_LOG2", 9)
+    monkeypatch.setattr(bitonic, "PAIR_BLOCK_LOG2", 9)
+    x = rng.integers(-(2**62), 2**62, size=4096, dtype=np.int64)
+    got = sort(x, algorithm="sample", mesh=mesh8)
+    np.testing.assert_array_equal(got, np.sort(x))
+
+
 @pytest.mark.parametrize("algo", ALGOS)
 @pytest.mark.parametrize("dtype", [np.float32, np.float64])
 def test_float_keys(algo, dtype, mesh8, rng):

@@ -76,28 +76,33 @@ def main() -> int:
     row["bitonic_matches_lax"] = agree
     ok &= agree
 
-    def slope(fn, reps=(1, 3), tries=3):
+    def slope(fn, args, reps=(1, 3), tries=3):
+        """Slope-method device time of ``fn`` (operand tuple -> operand
+        tuple), with a forced scalar fetch after each timed call —
+        block_until_ready is advisory over this image's tunnel."""
         out = {}
         for r in reps:
             @jax.jit
-            def g(v, r=r):
+            def g(ops, r=r):
                 for _ in range(r):
-                    v = fn(v)
-                return v
-            y = g(x)
-            jax.device_get(y[:1])  # block_until_ready is advisory here
+                    ops = fn(*ops)
+                return ops
+            y = g(args)
+            jax.device_get(y[0][:1])
             ts = []
             for _ in range(tries):
                 t = time.perf_counter()
-                y = g(x)
-                jax.device_get(y[:1])
+                y = g(args)
+                jax.device_get(y[0][:1])
                 ts.append(time.perf_counter() - t)
             out[r] = min(ts)
         return (out[reps[1]] - out[reps[0]]) / (reps[1] - reps[0])
 
-    bit_ms = slope(lambda v: bitonic.sort_padded(v, n, bitonic.BLOCK_LOG2)) * 1e3
+    bit_ms = slope(
+        lambda v: (bitonic.sort_padded(v, n, bitonic.BLOCK_LOG2),), (x,)) * 1e3
     lax_ms = slope(
-        lambda v: jax.lax.sort([v], num_keys=1, is_stable=False)[0]) * 1e3
+        lambda v: (jax.lax.sort([v], num_keys=1, is_stable=False)[0],),
+        (x,)) * 1e3
     ratio = lax_ms / bit_ms if bit_ms > 0 else float("nan")
     print(f"bitonic {bit_ms:.1f} ms  lax.sort {lax_ms:.1f} ms  "
           f"ratio {ratio:.2f}x (BASELINE.md regression band: 1.6-2.2x)",
@@ -123,30 +128,13 @@ def main() -> int:
     row["pair_matches_lax"] = pagree
     ok &= pagree
 
-    def slope2(fn, reps=(1, 3), tries=3):
-        out = {}
-        for r in reps:
-            @jax.jit
-            def g(h, l, r=r):
-                for _ in range(r):
-                    h, l = fn(h, l)
-                return h, l
-            y = g(x, lo2)
-            jax.device_get(y[0][:1])
-            ts = []
-            for _ in range(tries):
-                t = time.perf_counter()
-                y = g(x, lo2)
-                jax.device_get(y[0][:1])
-                ts.append(time.perf_counter() - t)
-            out[r] = min(ts)
-        return (out[reps[1]] - out[reps[0]]) / (reps[1] - reps[0])
-
-    pair_ms = slope2(
-        lambda h, l: kernels.sort_two_words_bitonic(h, l)[:2]) * 1e3
-    lax2_ms = slope2(
+    pair_ms = slope(
+        lambda h, l: kernels.sort_two_words_bitonic(h, l)[:2],
+        (x, lo2)) * 1e3
+    lax2_ms = slope(
         lambda h, l: tuple(jax.lax.sort([h, l], num_keys=2,
-                                        is_stable=False))) * 1e3
+                                        is_stable=False)),
+        (x, lo2)) * 1e3
     pratio = lax2_ms / pair_ms if pair_ms > 0 else float("nan")
     print(f"pair {pair_ms:.1f} ms  lax.sort-2w {lax2_ms:.1f} ms  "
           f"ratio {pratio:.2f}x (regression band: 1.25-1.45x)", flush=True)

@@ -73,6 +73,46 @@ def test_aot_sample_pallas_v5e8(v5e8_mesh):
     assert compiled is not None
 
 
+def test_aot_pair_engine_v5e8(v5e8_mesh):
+    """The 64-bit PAIR engine (round 4) — pair block sort / cross /
+    merge kernels + the in-VMEM run-fix kernel + the on-device residual
+    cond — compiles as REAL Mosaic kernels under shard_map over 8
+    chips: the distributed sample path's 2-word per-shard sort.  CI
+    otherwise only interprets these kernels; this is the lowering
+    gate (`make chip-test` is the numerics gate)."""
+    n, cap = 1 << 14, 1 << 13
+
+    def step(words):
+        out, cnt, mc = sample_sort.sample_sort_spmd(
+            words, 2, 8, cap, 15, pack="pallas", engine="bitonic")
+        return out[0], out[1], cnt[None], mc
+
+    fn = jax.shard_map(step, mesh=v5e8_mesh, in_specs=((P(AXIS), P(AXIS)),),
+                       out_specs=(P(AXIS), P(AXIS), P(AXIS), P()),
+                       check_vma=False)
+    words = (_sharded_input(v5e8_mesh, n), _sharded_input(v5e8_mesh, n))
+    compiled = jax.jit(fn).lower(words).compile()
+    assert compiled is not None
+
+
+def test_aot_pair_local_fused_v5e8(v5e8_mesh):
+    """The fused single-device adaptive 64-bit program (encode + range +
+    sniff + lax.cond tree over 1-word engine / lax / pair engine,
+    models/api.py::_compile_pair_fused) lowers through the real TPU
+    compiler for one chip of the topology — every cond branch compiles,
+    including the constant-word 1-word-engine branches."""
+    from mpitest_tpu.models.api import _compile_pair_fused
+
+    dev = v5e8_mesh.devices.flat[0]
+    x = jax.ShapeDtypeStruct(
+        (1 << 14,), jnp.int64,
+        sharding=NamedSharding(Mesh(np.array([dev]), (AXIS,)), P()),
+    )
+    with jax.enable_x64(True):
+        fn = _compile_pair_fused("int64", "bitonic")
+        assert fn.lower(x).compile() is not None
+
+
 def test_aot_radix_v5e16_two_slices():
     """The BASELINE row-5 hardware config (v5e-16 = two 2x4 slices):
     the radix program compiles over the hybrid DCN+ICI 16-chip mesh —

@@ -70,11 +70,104 @@ def adversarial_patterns_at_scale(log2n: int = 28) -> None:
         print(f"adversarial {name} @2^{log2n}: OK")
 
 
+def adversarial_patterns_64(log2n: int = 26) -> None:
+    """At-scale int64 battery through the PUBLIC API on the pair engine
+    (round 4): one pattern per adaptive route — pair engine, both
+    constant-word shortcuts, the duplication-sniff reroute, and the
+    residual on-device fallback (runs the sniff cannot see) — each
+    verified ON DEVICE (lexicographic sortedness of the word planes +
+    per-word sum/xor multiset invariants vs the encoded input; results
+    never cross the tunnel).  Asserts the tracer recorded the expected
+    engine route, so a silent routing regression fails loudly.
+
+    ``STRESS64_PATTERNS=a,b`` selects a subset (resumable under a
+    degraded tunnel); ``STRESS64_LOG2N`` overrides the size.
+    """
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_enable_x64", True)
+    from mpitest_tpu.ops.keys import codec_for
+    from mpitest_tpu.utils.trace import Tracer
+
+    log2n = int(os.environ.get("STRESS64_LOG2N", str(log2n)))
+    n = 1 << log2n
+    r = np.random.default_rng(5)
+    codec = codec_for(np.int64)
+
+    def mid_runs():
+        # runs of 16 equal-hi keys over ~n/16 distinct hi values: far
+        # too many distinct values for the 1024-key sniff to see, far
+        # too long for the 8-pass run fix -> the residual flag MUST
+        # fire and the on-device lax fallback must produce exact bytes.
+        hi = np.repeat(r.integers(0, 2**31, n // 16 + 1).astype(np.int64),
+                       16)[:n]
+        x = (hi << 32) | r.integers(0, 2**32, n).astype(np.int64)
+        r.shuffle(x)
+        return x
+
+    pats = {
+        # name: (generator, accepted engine routes)
+        "uniform": (lambda: r.integers(-(2**63), 2**63 - 1, n,
+                                       dtype=np.int64),
+                    {"bitonic_pair"}),
+        "narrow-hi": (lambda: r.integers(0, 2**31, n, dtype=np.int64),
+                      {"bitonic_1w1"}),
+        "wide-lo-const": (lambda: (n - 1 - np.arange(n, dtype=np.int64)) << 37,
+                          {"bitonic_1w0"}),
+        "all-equal": (lambda: np.full(n, -42, np.int64), {"constant"}),
+        # hi from 8 values: the sniff must catch it and reroute
+        "hi-dup8": (lambda: (r.integers(0, 8, n).astype(np.int64) << 33)
+                    | r.integers(0, 2**32, n).astype(np.int64), {"lax"}),
+        # sniff usually misses (residual fallback); a lucky sample
+        # collision may reroute up front — both are correct routes
+        "mid-runs16": (mid_runs, {"bitonic_pair+lax_fallback", "lax"}),
+    }
+    only = os.environ.get("STRESS64_PATTERNS")
+    sel = set(only.split(",")) if only else None
+
+    @jax.jit
+    def check(x, hi_o, lo_o):
+        hi_i, lo_i = codec.encode_jax(x)
+        asc = (hi_o[1:] > hi_o[:-1]) | ((hi_o[1:] == hi_o[:-1])
+                                        & (lo_o[1:] >= lo_o[:-1]))
+        xor = lambda a: jax.lax.reduce(a, jnp.uint32(0),
+                                       jax.lax.bitwise_xor, (0,))
+        return (jnp.all(asc),
+                (hi_i.sum() == hi_o.sum()) & (lo_i.sum() == lo_o.sum()),
+                (xor(hi_i) == xor(hi_o)) & (xor(lo_i) == xor(lo_o)))
+
+    for name, (gen, routes) in pats.items():
+        if sel is not None and name not in sel:
+            continue
+        x = gen()
+        dev = jax.device_put(x, jax.devices()[0])
+        jax.device_get(dev[-1:])  # materialize the (lazy) ingest
+        tracer = Tracer()
+        res = mpitest_tpu.sort(dev, algorithm="radix", return_result=True,
+                               tracer=tracer)
+        hi_o, lo_o = res.words
+        checks = [bool(t) for t in jax.device_get(check(dev, hi_o, lo_o))]
+        route = tracer.counters.get("local_engine")
+        ok = all(checks) and route in routes
+        print(f"int64 {name} @2^{log2n}: "
+              f"{'OK' if ok else f'FAIL {checks}'} route={route}"
+              f"{'' if route in routes else f' (expected {sorted(routes)})'}",
+              flush=True)
+        assert ok, (name, checks, route)
+        del res, hi_o, lo_o, dev
+
+
 if __name__ == "__main__":
-    # `--patterns` runs ONLY the at-scale adversarial battery (each mode
-    # alone fits a 10-minute chip budget); default = the randomized
+    # `--patterns` runs ONLY the at-scale adversarial battery; \
+    # `--patterns64` the int64 pair-engine battery (each mode alone
+    # fits a 10-minute chip budget); default = the randomized
     # cross-dtype API battery.
-    if "--patterns" in sys.argv:
+    if "--patterns64" in sys.argv:
+        adversarial_patterns_64()
+    elif "--patterns" in sys.argv:
         adversarial_patterns_at_scale()
     else:
         randomized_api_battery()

@@ -102,6 +102,57 @@ def test_mid_runs_residual_fallback(mesh1, rng, monkeypatch):
     assert tracer.counters.get("pair_residual_fallback") == 1
 
 
+hypothesis = pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+
+@settings(max_examples=20, deadline=None)
+@given(data=st.data())
+def test_property_sort_two_words_contract(data):
+    """For ARBITRARY run profiles (run lengths 1..24 — straddling the
+    8-pass fix-up threshold both ways — random lo, shuffled input,
+    non-power-of-two n): sort_two_words_bitonic either returns the
+    exact lexicographic sort with residual=False, or residual=True;
+    the pair multiset is preserved in every case, and residual=False
+    is GUARANTEED when all runs are <= 8.  The correctness contract
+    the api fallback relies on."""
+    import jax.numpy as jnp
+
+    from mpitest_tpu.ops import bitonic, kernels
+
+    rng = np.random.default_rng(data.draw(st.integers(0, 2**31)))
+    n = data.draw(st.integers(300, 3000))
+    max_run = data.draw(st.integers(1, 24))
+    lens = []
+    total = 0
+    while total < n:
+        l = min(int(rng.integers(1, max_run + 1)), n - total)
+        lens.append(l)
+        total += l
+    hi = np.repeat(
+        rng.choice(2**32, size=len(lens), replace=False).astype(np.uint32),
+        lens)
+    lo = rng.integers(0, 2**32, n, dtype=np.uint64).astype(np.uint32)
+    perm = rng.permutation(n)
+    hi, lo = hi[perm], lo[perm]
+    # shrink the engine constants so these sizes run the REAL network
+    # (multi-block: cross + merge + run-fix + boundary strips), not the
+    # small-n lax shortcut
+    with pytest.MonkeyPatch.context() as mp:
+        mp.setattr(bitonic, "MIN_SORT_LOG2", 8)
+        mp.setattr(bitonic, "PAIR_BLOCK_LOG2", 9)
+        hs, ls, bad = kernels.sort_two_words_bitonic(
+            jnp.asarray(hi), jnp.asarray(lo), interpret=True)
+    hs, ls, bad = np.asarray(hs), np.asarray(ls), bool(bad)
+    key_in = (hi.astype(np.uint64) << 32) | lo
+    key_out = (hs.astype(np.uint64) << 32) | ls
+    np.testing.assert_array_equal(np.sort(key_out), np.sort(key_in))
+    if max(lens) <= 8:
+        assert not bad
+    if not bad:
+        np.testing.assert_array_equal(key_out, np.sort(key_in))
+
+
 def test_device_resident_pair_engine(mesh1, rng, monkeypatch):
     """Device-resident int64 input goes through the fused on-device
     encode+range+sniff program (no host round-trip of the keys)."""

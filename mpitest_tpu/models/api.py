@@ -298,7 +298,7 @@ def _compile_pair_fused(dtype_name: str, impl: str):
         n = hi.shape[0]
         s = min(1024, n)
         if s > 1:
-            stride = max(1, (n - 1) // (s - 1))
+            stride = -(-(n - 1) // (s - 1))  # ceil: sample stays <= s picks
             s_eff = (n - 1) // stride + 1
             start = (n - 1) - (s_eff - 1) * stride
             samp = jlax.sort(
@@ -617,7 +617,10 @@ def _compile_skew_sniff(mesh: Mesh, n_words: int, n_valid: int, n_ranks: int):
     the global max — outside the sample."""
     s = min(n_valid, max(64, 32 * n_ranks))
     if s > 1:
-        stride = max(1, (n_valid - 1) // (s - 1))
+        # Ceil, not floor: floor division made stride 1 whenever
+        # n_valid < 2s, inflating the "sample" to nearly the whole shard
+        # (ADVICE r4 #3).  Ceil keeps the pick count <= the requested s.
+        stride = -(-(n_valid - 1) // (s - 1))
         s = (n_valid - 1) // stride + 1   # picks that fit the range
         start = (n_valid - 1) - (s - 1) * stride  # last pick = n_valid-1
     else:

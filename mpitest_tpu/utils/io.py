@@ -52,7 +52,11 @@ def read_keys_text(path: str, dtype=np.int32) -> np.ndarray:
         # Float tokens (decimal/exponent/inf/nan forms) parse through
         # Python float() — exact IEEE double semantics; the int64
         # intermediate below would garble them (VERDICT r3 weak #3).
-        # float32 narrows from the exact double, i.e. correct rounding.
+        # float32 narrows from that double (C strtod-then-narrow
+        # semantics): for long decimal tokens the two roundings can
+        # differ from a direct correctly-rounded decimal->f32 parse in
+        # the last ulp; shortest-round-trip outputs (write_keys_text)
+        # are unaffected, so self-round-trip stays bit-exact.
         with open(path) as f:
             return np.array([float(t) for t in f.read().split()],
                             dtype=np.float64).astype(dt)

@@ -379,6 +379,7 @@ def minimpi_binaries():
         "sample": str(REPO / "bench" / "sample_sort_minimpi"),
         "radix": str(REPO / "bench" / "radix_sort_minimpi"),
         "selftest": str(REPO / "bench" / "comm_selftest_minimpi"),
+        "earlyexit": str(REPO / "bench" / "minimpi_earlyexit"),
     }
 
 
@@ -438,8 +439,7 @@ def test_minimpi_early_exit_kills_job(minimpi_binaries):
     with a nonzero status (ADVICE r3): before the finalized-rank
     tracking, the supervisor saw a clean exit and the remaining ranks
     hung in the process-shared barrier forever."""
-    r = run_minimpi(str(REPO / "bench" / "minimpi_earlyexit"), [], 4,
-                    timeout=30)
+    r = run_minimpi(minimpi_binaries["earlyexit"], [], 4, timeout=30)
     assert r.returncode != 0
     assert "exited before MPI_Finalize" in r.stderr
 

@@ -93,6 +93,12 @@ _PAIR_CROSS_GROUP = 4      # blocks per pair cross-layer transfer group
 _PAIR_MERGE_BITS = 2       # cross bits fused into the pair merge tail
 #: blocks per cross-layer transfer group (see ``_cross_kernel``).
 _CROSS_GROUP = 8
+#: Raised scoped-VMEM budget for the round-5 relayout kernels.  The
+#: 16 MiB default is a compiler parameter, not hardware (v5e VMEM is
+#: 128 MiB); 48 MiB admits the wide shapes round 4 recorded as walls
+#: (2-block member windows, the 25.6 MiB 8-member pair merge) while
+#: leaving ample room for the pipeline's double buffers.
+_VMEM_LIMIT = 48 * 1024 * 1024
 
 #: Index-map constants pinned to int32: under jax_enable_x64 (the
 #: device-resident 64-bit path) Python-int literals in index maps
@@ -519,20 +525,7 @@ def _merge_pair_kernel(s_ref, k_ref, p_ref, ok_ref, op_ref, *,
     desc = [((bid >> sign_shift) & 1) == 1 for bid in bids]
     ks = [jnp.where(desc[i], ~k_ref[i], k_ref[i]) for i in range(n_members)]
     ps = [p_ref[i] for i in range(n_members)]
-
-    c = n_members.bit_length() - 1
-    for kbit in range(c - 1, -1, -1):
-        for i in range(n_members):
-            if (i >> kbit) & 1:
-                continue
-            j = i | (1 << kbit)
-            lo = jnp.minimum(ks[i], ks[j])
-            hi = jnp.maximum(ks[i], ks[j])
-            p_lo = jnp.where(lo == ks[i], ps[i], ps[j])
-            p_hi = jnp.where(hi == ks[j], ps[j], ps[i])
-            ks[i], ks[j] = lo, hi
-            ps[i], ps[j] = p_lo, p_hi
-
+    _closure_ladder(ks, ps, n_members.bit_length() - 1)
     for i in range(n_members):
         k, p = _sweep_pair(ks[i], ps[i], b_log2)
         ok_ref[i] = jnp.where(desc[i], ~k, k)
@@ -608,7 +601,7 @@ def _compile_merge_pair(n_members: int, nblk: int, s_rows: int, b_log2: int,
 
 
 def sort_pairs_padded(k, p, n_pow2: int, b_log2: int,
-                      interpret: bool = False):
+                      interpret: bool = False, relayout: bool = True):
     """Bitonic-sort uint32 ``(k, p)`` pairs by the KEY plane only.
 
     Same network as :func:`sort_padded`; the payload plane rides every
@@ -616,6 +609,13 @@ def sort_pairs_padded(k, p, n_pow2: int, b_log2: int,
     payloads at every comparator, so the output payload order within an
     equal-key run is an arbitrary (but deterministic) permutation — the
     64-bit caller fixes runs afterwards (``kernels.sort_two_words``).
+
+    ``relayout`` (round 5, default): stages with >= 1 single cross
+    layer run the rotation-relayout schedule — fused 2-bit (odd
+    residue: one 1-bit) closure visits at 2n traffic per visit instead
+    of 3n per layer, closed by the rotation-aware merge.  ``False``
+    keeps the round-4 one-layer-at-a-time cross path (the A/B
+    baseline; see BASELINE.md round-5 section).
 
     Returns ``(k_sorted, p_permuted)``, both flat uint32 [n_pow2].
     """
@@ -631,11 +631,27 @@ def sort_pairs_padded(k, p, n_pow2: int, b_log2: int,
     kb, pb = _compile_block_sort_pair(nblk, s_rows, b_log2, interpret)(kb, pb)
 
     tail = _PAIR_MERGE_BITS  # log2(_PAIR_CROSS_GROUP): merge's cross share
-    cross = (_compile_cross_pair(nblk, s_rows, interpret)
-             if t > b_log2 + tail else None)
+    cross = (None if relayout else
+             (_compile_cross_pair(nblk, s_rows, interpret)
+              if t > b_log2 + tail else None))
 
     for m in range(b_log2 + 1, t + 1):
         nbits = m - b_log2
+        if relayout and nbits > tail:
+            # Rotation-relayout schedule: highest logical bit first, so
+            # an odd single-layer count leads with the 1-bit visit.
+            n_single = nbits - tail
+            jarr = jnp.asarray([nbits], jnp.int32)
+            if n_single % 2:
+                kb, pb = _compile_relayout_cross_pair(
+                    2, nblk, s_rows, interpret, bpm=2)(jarr, kb, kb, pb, pb)
+            visit2 = _compile_relayout_cross_pair(4, nblk, s_rows, interpret,
+                                                  bpm=2)
+            for _ in range(n_single // 2):
+                kb, pb = visit2(jarr, *([kb] * 4), *([pb] * 4))
+            kb, pb = _compile_rot_merge_pair(nblk, s_rows, b_log2, interpret)(
+                jarr, *([kb] * 4), *([pb] * 4))
+            continue
         for sj in range(nbits - 1, tail - 1, -1):
             kb, pb = cross(jnp.asarray([sj - tail, nbits], jnp.int32),
                            kb, kb, pb, pb)
@@ -645,6 +661,186 @@ def sort_pairs_padded(k, p, n_pow2: int, b_log2: int,
     k_out = lax.bitcast_convert_type(kb.reshape(-1), jnp.uint32)
     p_out = lax.bitcast_convert_type(pb.reshape(-1), jnp.uint32)
     return k_out ^ jnp.uint32(0x80000000), p_out
+
+
+# ------------------------------------------- relayout cross fusion (r5)
+#
+# The round-4 phase split put 56% of the pair network in its 36 single
+# cross layers: each one reads the whole array TWICE (both sides of the
+# pair, so one output array receives every group) and writes it once —
+# 3n traffic per layer, measured 1.89 ms against a 0.75 ms streaming
+# floor at 2^26.  The wall named in BASELINE.md: consecutive cross
+# layers at block bits (j, j-1) form 4-way XOR-closures whose members
+# are NOT contiguous, and a pallas grid step cannot write 4 scattered
+# windows of one output array.
+#
+# The fix is a *rotation relayout*: the closure members CAN be read
+# scattered (input index maps are arbitrary), so a grid step reads the
+# 4 blocks of one closure over the top two unprocessed block bits,
+# applies BOTH layers in VMEM, and writes one CONTIGUOUS 4-block group
+# — which implicitly rotates the two processed bits to the bottom of
+# the physical block index.  The invariant that makes one kernel serve
+# every visit: after each visit the next unprocessed logical bits sit
+# at the TOP of the physical index again, so every visit is "process
+# phys top bits, rotate them down", with the same index maps.  After
+# all visits the stage's merge reads its members through the
+# accumulated rotation (phys = s*2^(J-2) + h within the segment) and
+# writes natural order — the permutation never escapes the stage.
+#
+# Traffic per 2 layers: n read + n write (vs 6n for two single cross
+# layers).  Segment bits (>= J) never move, and every member of a
+# closure shares them, so the stage direction stays one scalar flip.
+
+
+def _closure_ladder(ks, ps, c: int):
+    """The pairwise key min/max + ``out_k == k`` payload-routing ladder
+    over an XOR-closure of ``2^c`` members, highest bit first — shared
+    by the merge tails and the relayout visits (the tie rule — equal
+    keys keep their own payloads on both sides — must stay identical
+    across every schedule)."""
+    n_members = len(ks)
+    for kbit in range(c - 1, -1, -1):
+        for i in range(n_members):
+            if (i >> kbit) & 1:
+                continue
+            jm = i | (1 << kbit)
+            lo = jnp.minimum(ks[i], ks[jm])
+            hi = jnp.maximum(ks[i], ks[jm])
+            p_lo = jnp.where(lo == ks[i], ps[i], ps[jm])
+            p_hi = jnp.where(hi == ks[jm], ps[jm], ps[i])
+            ks[i], ks[jm] = lo, hi
+            ps[i], ps[jm] = p_lo, p_hi
+
+
+def _relayout_cross_pair_kernel(s_ref, *refs, n_members: int, bpm: int):
+    """Fused visit over the top ``c = log2(n_members)`` physical block
+    bits of each 2^J-block segment (J = ``s_ref[0]`` in block bits):
+    the c cross layers of one XOR-closure, highest logical bit first,
+    in one VMEM visit.  ``refs`` = n_members key refs, n_members
+    payload refs, then the key/payload outputs.  ``bpm`` = consecutive
+    blocks per member window (``bpm = 2`` halves the grid and doubles
+    the DMA size — each grid step carries two whole closures at
+    adjacent q; measured: single-block member DMAs ran the visit at
+    ~2x the streaming floor).  Sub-window b of member s belongs to
+    closure q = bpm*w + b and writes output row ``b*n_members + s``."""
+    j_bits = s_ref[0]
+    g = pl.program_id(0)
+    c = n_members.bit_length() - 1
+    lb = bpm.bit_length() - 1
+    desc = ((g >> (j_bits - lb - c)) & 1) == 1  # segment bit = flat bit m
+    ok_ref, op_ref = refs[2 * n_members], refs[2 * n_members + 1]
+    for b in range(bpm):
+        ks = [jnp.where(desc, ~refs[i][b], refs[i][b])
+              for i in range(n_members)]
+        ps = [refs[n_members + i][b] for i in range(n_members)]
+        _closure_ladder(ks, ps, c)
+        for i in range(n_members):
+            ok_ref[b * n_members + i] = jnp.where(desc, ~ks[i], ks[i])
+            op_ref[b * n_members + i] = ps[i]
+
+
+@functools.lru_cache(maxsize=16)
+def _compile_relayout_cross_pair(n_members: int, nblk: int, s_rows: int,
+                                 interpret: bool, bpm: int = 2):
+    """One visit = grid over output groups of ``bpm * n_members``
+    contiguous blocks; member ``s`` reads the PHYSICAL ``bpm``-block
+    window at ``(seg << J') + (s << (J'-c)) + w`` in window units
+    (J' = J - log2(bpm)) — the closures over the segment's top c
+    physical bits for ``bpm`` adjacent q — and lands contiguously, so
+    the c bits rotate to the bottom of the physical block index."""
+    c = n_members.bit_length() - 1
+    lb = bpm.bit_length() - 1
+
+    def member_map(s):
+        def f(g, s_ref):
+            j_w = s_ref[0] - lb       # segment bits in window units
+            qbits = j_w - c
+            seg = g >> qbits
+            w = g & ((1 << qbits) - 1)
+            return ((seg << j_w) + (s << qbits) + w, _Z, _Z)
+        return f
+
+    mspec = lambda s: pl.BlockSpec((bpm, s_rows, LANES), member_map(s),
+                                   memory_space=pltpu.VMEM)
+    ospec = pl.BlockSpec((bpm * n_members, s_rows, LANES),
+                         lambda g, s: (g, _Z, _Z),
+                         memory_space=pltpu.VMEM)
+    shape = jax.ShapeDtypeStruct((nblk, s_rows, LANES), jnp.int32)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nblk // (bpm * n_members),),
+        in_specs=[mspec(s) for s in range(n_members)] * 2,
+        out_specs=[ospec, ospec],
+    )
+    return pl.pallas_call(
+        functools.partial(_relayout_cross_pair_kernel, n_members=n_members,
+                          bpm=bpm),
+        out_shape=[shape, shape],
+        grid_spec=grid_spec,
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
+        interpret=interpret,
+    )
+
+
+def _rot_merge_pair_kernel(s_ref, *refs, n_members: int, s_rows: int,
+                           b_log2: int):
+    """:func:`_merge_pair_kernel` with gather inputs: member ``s`` was
+    read through the stage's accumulated rotation, so the body is the
+    identical cross-tail + sweep; the block id used for the stage
+    direction is the segment bit, shared by all members."""
+    j_bits = s_ref[0]
+    g = pl.program_id(0)
+    desc = ((g >> (j_bits - 2)) & 1) == 1
+    ks = [jnp.where(desc, ~refs[i][0], refs[i][0]) for i in range(n_members)]
+    ps = [refs[n_members + i][0] for i in range(n_members)]
+    ok_ref, op_ref = refs[2 * n_members], refs[2 * n_members + 1]
+    _closure_ladder(ks, ps, n_members.bit_length() - 1)
+    for i in range(n_members):
+        k, p = _sweep_pair(ks[i], ps[i], b_log2)
+        ok_ref[i] = jnp.where(desc, ~k, k)
+        op_ref[i] = p
+
+
+@functools.lru_cache(maxsize=16)
+def _compile_rot_merge_pair(nblk: int, s_rows: int, b_log2: int,
+                            interpret: bool):
+    """Stage-final merge reading through the accumulated rotation: after
+    the visits consumed logical bits J-1..2, the remaining logical bits
+    (1, 0) sit at the TOP of the physical index — member ``s`` of
+    logical group ``h`` lives at phys ``(seg << J) + (s << (J-2)) + h``.
+    Writes natural logical order (contiguous groups of 4), closing the
+    stage's permutation."""
+    n_members = 4
+
+    def member_map(s):
+        def f(g, s_ref):
+            j_bits = s_ref[0]
+            hbits = j_bits - 2
+            seg = g >> hbits
+            h = g & ((1 << hbits) - 1)
+            return ((seg << j_bits) + (s << hbits) + h, _Z, _Z)
+        return f
+
+    mspec = lambda s: pl.BlockSpec((1, s_rows, LANES), member_map(s),
+                                   memory_space=pltpu.VMEM)
+    ospec = pl.BlockSpec((n_members, s_rows, LANES),
+                         lambda g, s: (g, _Z, _Z),
+                         memory_space=pltpu.VMEM)
+    shape = jax.ShapeDtypeStruct((nblk, s_rows, LANES), jnp.int32)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nblk // n_members,),
+        in_specs=[mspec(s) for s in range(n_members)] * 2,
+        out_specs=[ospec, ospec],
+    )
+    return pl.pallas_call(
+        functools.partial(_rot_merge_pair_kernel, n_members=n_members,
+                          s_rows=s_rows, b_log2=b_log2),
+        out_shape=[shape, shape],
+        grid_spec=grid_spec,
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
+        interpret=interpret,
+    )
 
 
 def _fix_runs_pair_kernel(k_ref, p_ref, o_ref, *, passes: int, s_rows: int):

@@ -53,6 +53,7 @@ def _check_pairs(k, p, ks, ps):
     )
 
 
+@pytest.mark.parametrize("relayout", [True, False])
 @pytest.mark.parametrize(
     "n_log2,b_log2,span",
     [
@@ -61,15 +62,19 @@ def _check_pairs(k, p, ks, ps):
         (13, 10, 256),   # merge stages, duplicated keys
         (15, 11, 1 << 32),   # one grouped cross layer
         (16, 11, 64),    # cross layers at two distances + heavy dups
+        (17, 10, 1 << 32),   # nbits up to 7: odd AND even visit counts
     ],
 )
-def test_sort_pairs_padded(n_log2, b_log2, span):
+def test_sort_pairs_padded(n_log2, b_log2, span, relayout):
+    """Both cross schedules: the round-5 rotation-relayout fused visits
+    (default) and the round-4 single-layer path (the A/B baseline)."""
     rng = np.random.default_rng(n_log2 * 37 + b_log2)
     n = 1 << n_log2
     k = rng.integers(0, span, n).astype(np.uint32)
     p = rng.integers(0, 1 << 32, n, dtype=np.uint32)
     ks, ps = bitonic.sort_pairs_padded(jnp.asarray(k), jnp.asarray(p),
-                                       n, b_log2, interpret=True)
+                                       n, b_log2, interpret=True,
+                                       relayout=relayout)
     _check_pairs(k, p, np.asarray(ks), np.asarray(ps))
 
 

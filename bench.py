@@ -24,7 +24,10 @@ N, which is one reason the target scale is 2^28+.
 
 Env knobs: BENCH_LOG2N (default 28 on TPU, 20 on CPU), BENCH_ALGO
 (radix|sample), BENCH_REPEATS (default 3), BENCH_DTYPE (int32),
-BENCH_NATIVE_RANKS (default 8; 0 disables the native denominator).
+BENCH_NATIVE_RANKS (default 8; 0 disables the native denominator),
+BENCH_NATIVE_REPEATS (default 3 — the denominator is the MEDIAN of
+these runs; see CANONICAL_NATIVE_MKEYS for the pinned cross-round
+protocol, VERDICT r4 weak #4).
 """
 
 from __future__ import annotations
@@ -66,10 +69,30 @@ def encoded_median(x_or_scalar, dtype: np.dtype) -> int:
     return int(np.sort(enc)[arr.size // 2 - 1]) if arr.size > 1 else int(enc[0])
 
 
-def measure_native(x: np.ndarray, algo: str, ranks: int) -> float | None:
+#: Canonical north-star denominator (VERDICT r4 weak #4): the native
+#: backend's throughput measured median-of-5 in one quiet session (no
+#: concurrent chip or pytest load on this image's single CPU core), so
+#: the headline ratio has a reproducible denominator instead of a
+#: weather-dependent one.  Keyed by (algo, log2n, dtype, ranks);
+#: measured band recorded beside it.  bench.py reports BOTH the same-run
+#: ratio (vs_baseline) and vs_canonical when the config matches.
+CANONICAL_NATIVE_MKEYS: dict = {
+    # Median of 5 runs, quiet session (no concurrent pytest/chip jobs),
+    # 2026-07-31; band 9.94-13.78 Mkeys/s.  A loaded-CPU session the
+    # same day measured 4.65 (band 3.95-6.11) — the 2.7x swing is why
+    # the ratio is pinned.  Protocol to re-pin: BASELINE.md round-5
+    # "north-star denominator" section.
+    ("radix", 28, "int32", 8): 12.641,
+}
+
+
+def measure_native(x: np.ndarray, algo: str, ranks: int,
+                   repeats: int = 3) -> float | None:
     """Run the repo's native backend (pthreads, `ranks` host-CPU ranks) on
-    the same keys; return its own timer's seconds (the reference span:
-    after-read through final gather), or None if unavailable.  Never
+    the same keys; return the MEDIAN of ``repeats`` runs of its own timer
+    (the reference span: after-read through final gather), or None if
+    unavailable.  Median-of-N because the 8-rank run on this image's one
+    CPU core swings 1.5-4x run to run (VERDICT r4 weak #4).  Never
     raises: a missing toolchain / full /tmp / timeout must not cost the
     already-measured TPU result its stdout JSON line."""
     try:
@@ -93,10 +116,21 @@ def measure_native(x: np.ndarray, algo: str, ranks: int) -> float | None:
             path = f.name
         try:
             write_keys_binary(path, x)
-            secs, err = run_native_sort(binary, path, ranks)
-            if err:
-                log(f"native baseline: {err}")
-            return secs
+            times = []
+            for _ in range(max(1, repeats)):
+                secs, err = run_native_sort(binary, path, ranks)
+                if err:
+                    log(f"native baseline: {err}")
+                if secs is None:
+                    break
+                times.append(secs)
+            if not times:
+                return None
+            times.sort()
+            if len(times) > 1:
+                log(f"native baseline: median of {len(times)} runs "
+                    f"(band {times[0]:.2f}-{times[-1]:.2f}s)")
+            return times[len(times) // 2]
         finally:
             os.unlink(path)
     except Exception as e:  # noqa: BLE001 — baseline is best-effort
@@ -215,8 +249,10 @@ def main() -> None:
     # host-CPU MPI"; the pthreads backend is the same shared-memory
     # transport class mpirun uses on one host).
     vs_native = None
+    native_repeats = int(os.environ.get("BENCH_NATIVE_REPEATS", "3"))
     if native_ranks > 0:
-        native_s = measure_native(x, algo, native_ranks)
+        native_s = measure_native(x, algo, native_ranks,
+                                  repeats=native_repeats)
         if native_s is not None:
             native_mkeys = n / native_s / 1e6
             vs_native = mkeys / native_mkeys
@@ -224,6 +260,13 @@ def main() -> None:
                 f"{native_mkeys:.1f} Mkeys/s -> vs_native = {vs_native:.2f}x")
             metrics.record(f"native_{native_ranks}rank_mkeys_per_s",
                            round(native_mkeys, 3), "Mkeys/s")
+    # Canonical (pinned) denominator: reproducible across rounds even
+    # when the same-run native measurement rides a loaded CPU.
+    canon = CANONICAL_NATIVE_MKEYS.get((algo, log2n, dtype.name, native_ranks))
+    vs_canonical = mkeys / canon if canon else None
+    if vs_canonical is not None:
+        log(f"vs_canonical (pinned {canon} Mkeys/s): {vs_canonical:.2f}x")
+        metrics.record("vs_canonical_native", round(vs_canonical, 3), "x")
 
     metrics.record("baseline_np_sort_mkeys_per_s", round(np_mkeys, 3), "Mkeys/s")
     metrics.record("ingest_gb_per_s", round(x.nbytes / ingest_s / 1e9, 3), "GB/s")
@@ -236,7 +279,7 @@ def main() -> None:
     # could not run, the fallback denominator is named in "baseline" so
     # a consumer can never mistake np.sort for the 8-rank target.
     vs_baseline = vs_native if vs_native is not None else mkeys / np_mkeys
-    print(json.dumps({
+    out = {
         "metric": metric_name,
         "value": round(mkeys, 2),
         "unit": "Mkeys/s",
@@ -244,7 +287,10 @@ def main() -> None:
         "baseline": (f"native_{native_ranks}rank" if vs_native is not None
                      else "np_sort"),
         "vs_np_sort": round(mkeys / np_mkeys, 3),
-    }))
+    }
+    if vs_canonical is not None:
+        out["vs_canonical_native"] = round(vs_canonical, 3)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

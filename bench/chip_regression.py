@@ -105,7 +105,8 @@ def main() -> int:
         (x,)) * 1e3
     ratio = lax_ms / bit_ms if bit_ms > 0 else float("nan")
     print(f"bitonic {bit_ms:.1f} ms  lax.sort {lax_ms:.1f} ms  "
-          f"ratio {ratio:.2f}x (BASELINE.md regression band: 1.6-2.2x)",
+          f"ratio {ratio:.2f}x (BASELINE.md regression band: 2.0-4.2x "
+          "post-relayout; r4 band was 1.6-2.2x)",
           flush=True)
     row.update(bitonic_ms=round(bit_ms, 1), lax_sort_ms=round(lax_ms, 1),
                bitonic_speedup=round(ratio, 2))
@@ -137,7 +138,8 @@ def main() -> int:
         (x, lo2)) * 1e3
     pratio = lax2_ms / pair_ms if pair_ms > 0 else float("nan")
     print(f"pair {pair_ms:.1f} ms  lax.sort-2w {lax2_ms:.1f} ms  "
-          f"ratio {pratio:.2f}x (regression band: 1.25-1.45x)", flush=True)
+          f"ratio {pratio:.2f}x (regression band: 1.5-2.3x post-relayout; "
+          "r4 band was 1.25-1.45x)", flush=True)
     row.update(pair_ms=round(pair_ms, 1), lax_sort_2w_ms=round(lax2_ms, 1),
                pair_speedup=round(pratio, 2))
 

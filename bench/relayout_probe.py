@@ -15,7 +15,7 @@ build-or-refute on the real chip:
    skill): relayout network vs round-4 network vs variadic 2-word
    ``lax.sort``, plus the full ``sort_two_words_bitonic`` path.
 
-Resumable: ``PROBE_PARTS=agree,net,full`` (default all),
+Resumable: ``PROBE_PARTS=agree,net,1w,full`` (default all),
 ``PROBE_LOG2N`` (default 26).  Budget one part per invocation if the
 tunnel is degraded.
 """
@@ -46,7 +46,7 @@ def main() -> int:
     from mpitest_tpu.ops import bitonic, kernels
 
     log2n = int(os.environ.get("PROBE_LOG2N", "26"))
-    parts = os.environ.get("PROBE_PARTS", "agree,net,full").split(",")
+    parts = os.environ.get("PROBE_PARTS", "agree,net,1w,full").split(",")
     n = 1 << log2n
     rng = np.random.default_rng(7)
     k = jnp.asarray(rng.integers(0, 2**32, n, dtype=np.uint64)
@@ -119,6 +119,34 @@ def main() -> int:
               f"(relayout {old_ms / new_ms:.2f}x faster)", flush=True)
         row.update(pair_net_relayout_ms=round(new_ms, 1),
                    pair_net_r4_ms=round(old_ms, 1))
+
+    if "1w" in parts:
+        @jax.jit
+        def agree1(kk):
+            r = bitonic.sort_padded(kk, n, bitonic.BLOCK_LOG2, relayout=True)
+            o = bitonic.sort_padded(kk, n, bitonic.BLOCK_LOG2, relayout=False)
+            ref = jax.lax.sort([kk], num_keys=1, is_stable=False)[0]
+            return jnp.all(r == ref), jnp.all(r == o)
+
+        vs_lax1, vs_old1 = (bool(v) for v in jax.device_get(agree1(k)))
+        print(f"1w relayout keys==lax: {vs_lax1}  ==r4-schedule: {vs_old1}",
+              flush=True)
+        row.update(relayout1w_matches_lax=vs_lax1,
+                   relayout1w_matches_r4=vs_old1)
+        ok &= vs_lax1 and vs_old1
+        new1 = slope(
+            lambda kk: (bitonic.sort_padded(kk, n, bitonic.BLOCK_LOG2,
+                                            relayout=True),), (k,)) * 1e3
+        old1 = slope(
+            lambda kk: (bitonic.sort_padded(kk, n, bitonic.BLOCK_LOG2,
+                                            relayout=False),), (k,)) * 1e3
+        lax1 = slope(
+            lambda kk: (jax.lax.sort([kk], num_keys=1, is_stable=False)[0],),
+            (k,)) * 1e3
+        print(f"1w net relayout {new1:.1f} ms  r4 {old1:.1f} ms  "
+              f"lax {lax1:.1f} ms  (vs lax {lax1 / new1:.2f}x)", flush=True)
+        row.update(net1w_relayout_ms=round(new1, 1),
+                   net1w_r4_ms=round(old1, 1), lax_sort_1w_ms=round(lax1, 1))
 
     if "full" in parts:
         full_ms = slope(

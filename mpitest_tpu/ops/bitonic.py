@@ -281,20 +281,9 @@ def _merge_kernel(s_ref, x_ref, o_ref, *, n_members: int, s_rows: int,
     # makes every fused layer the raw ascending form.
     desc = [((bid >> sign_shift) & 1) == 1 for bid in bids]
     xs = [jnp.where(desc[i], ~x_ref[i], x_ref[i]) for i in range(n_members)]
-
-    c = n_members.bit_length() - 1
-    for k in range(c - 1, -1, -1):
-        for i in range(n_members):
-            if (i >> k) & 1:
-                continue
-            j = i | (1 << k)
-            # Members of a pair share the stage-direction bit (they
-            # differ only in bit k < sign_shift), so flipped ascending
-            # min/max is exact — two vector ops, no selects.
-            lo = jnp.minimum(xs[i], xs[j])
-            hi = jnp.maximum(xs[i], xs[j])
-            xs[i], xs[j] = lo, hi
-
+    # Members of a pair share the stage-direction bit (they differ only
+    # in bits below sign_shift), so flipped ascending min/max is exact.
+    _min_max_ladder(xs, n_members.bit_length() - 1)
     for i in range(n_members):
         x = _sweep(xs[i], b_log2)
         o_ref[i] = jnp.where(desc[i], ~x, x)
@@ -381,7 +370,8 @@ def _compile_merge(n_members: int, nblk: int, s_rows: int, b_log2: int,
     )
 
 
-def sort_padded(x, n_pow2: int, b_log2: int, interpret: bool = False):
+def sort_padded(x, n_pow2: int, b_log2: int, interpret: bool = False,
+                relayout: bool = True):
     """Bitonic-sort a padded power-of-two uint32 array of ``n_pow2``.
 
     ``x``: flat uint32 [n_pow2], ``n_pow2 = 2^t``, ``t >= b_log2 >= 10``.
@@ -391,6 +381,12 @@ def sort_padded(x, n_pow2: int, b_log2: int, interpret: bool = False):
     unsigned vector min/max): the sign bit is flipped on the way in and
     out — an order-preserving bijection uint32 -> int32, two cheap
     elementwise passes against ~100 network layers.
+
+    ``relayout`` (round 5, default): stages with single cross layers
+    run the rotation-relayout schedule (fused closure visits of up to
+    3 bits at 2-block member windows + the rotation-aware 8-member
+    merge) instead of one grouped cross layer at a time; see the
+    "relayout cross fusion" section and BASELINE.md round 5.
     """
     t = n_pow2.bit_length() - 1
     assert 1 << t == n_pow2 and t >= b_log2
@@ -401,10 +397,26 @@ def sort_padded(x, n_pow2: int, b_log2: int, interpret: bool = False):
 
     xb = _compile_block_sort(nblk, s_rows, b_log2, interpret)(xb)
 
-    cross = _compile_cross(nblk, s_rows, interpret) if t > b_log2 + 3 else None
+    cross = (None if relayout else
+             (_compile_cross(nblk, s_rows, interpret)
+              if t > b_log2 + 3 else None))
 
     for m in range(b_log2 + 1, t + 1):
         nbits = m - b_log2  # cross layers at block-bit positions nbits-1..0
+        if relayout and nbits > 3:
+            n_single = nbits - 3
+            jarr = jnp.asarray([nbits], jnp.int32)
+            if n_single % 3:
+                c = n_single % 3
+                visit = _compile_relayout_cross(1 << c, nblk, s_rows,
+                                                interpret)
+                xb = visit(jarr, *([xb] * (1 << c)))
+            visit3 = _compile_relayout_cross(8, nblk, s_rows, interpret)
+            for _ in range(n_single // 3):
+                xb = visit3(jarr, *([xb] * 8))
+            xb = _compile_rot_merge(nblk, s_rows, b_log2, 3, interpret)(
+                jarr, *([xb] * 8))
+            continue
         # High cross layers (block distance >= 8) one at a time; the
         # lowest min(nbits, 3) fuse into the merge kernel with the sweep.
         for sj in range(nbits - 1, 2, -1):
@@ -414,6 +426,140 @@ def sort_padded(x, n_pow2: int, b_log2: int, interpret: bool = False):
         xb = merge(jnp.asarray([m], jnp.int32), xb)
     out = xb.reshape(-1)
     return lax.bitcast_convert_type(out, jnp.uint32) ^ jnp.uint32(0x80000000)
+
+
+# ------------------------------------------- 1-word relayout cross (r5)
+#
+# Key-only twins of the rotation-relayout visit / rot-merge pair
+# kernels below (see the "relayout cross fusion" section): same
+# geometry, no payload plane.  Being single-plane, the 1-word shapes
+# afford 8-member closures (c=3) at 2-block member windows inside the
+# raised scoped-vmem budget, so each visit retires up to three cross
+# layers per n-read + n-write.
+
+
+def _min_max_ladder(ks, c: int):
+    """Key-only XOR-closure ladder: pairwise min/max, highest bit first
+    (members of a pair share the stage-direction bit, so the flipped
+    ascending form is exact — see :func:`_merge_kernel`)."""
+    n_members = len(ks)
+    for kbit in range(c - 1, -1, -1):
+        for i in range(n_members):
+            if (i >> kbit) & 1:
+                continue
+            jm = i | (1 << kbit)
+            ks[i], ks[jm] = jnp.minimum(ks[i], ks[jm]), \
+                jnp.maximum(ks[i], ks[jm])
+
+
+def _relayout_cross_kernel(s_ref, *refs, n_members: int, bpm: int):
+    """Key-only :func:`_relayout_cross_pair_kernel`."""
+    j_bits = s_ref[0]
+    g = pl.program_id(0)
+    c = n_members.bit_length() - 1
+    lb = bpm.bit_length() - 1
+    desc = ((g >> (j_bits - lb - c)) & 1) == 1
+    o_ref = refs[n_members]
+    for b in range(bpm):
+        ks = [jnp.where(desc, ~refs[i][b], refs[i][b])
+              for i in range(n_members)]
+        _min_max_ladder(ks, c)
+        for i in range(n_members):
+            o_ref[b * n_members + i] = jnp.where(desc, ~ks[i], ks[i])
+
+
+@functools.lru_cache(maxsize=16)
+def _compile_relayout_cross(n_members: int, nblk: int, s_rows: int,
+                            interpret: bool, bpm: int = 2):
+    """Key-only :func:`_compile_relayout_cross_pair`."""
+    c = n_members.bit_length() - 1
+    lb = bpm.bit_length() - 1
+
+    def member_map(s):
+        def f(g, s_ref):
+            j_w = s_ref[0] - lb
+            qbits = j_w - c
+            seg = g >> qbits
+            w = g & ((1 << qbits) - 1)
+            return ((seg << j_w) + (s << qbits) + w, _Z, _Z)
+        return f
+
+    mspec = lambda s: pl.BlockSpec((bpm, s_rows, LANES), member_map(s),
+                                   memory_space=pltpu.VMEM)
+    ospec = pl.BlockSpec((bpm * n_members, s_rows, LANES),
+                         lambda g, s: (g, _Z, _Z),
+                         memory_space=pltpu.VMEM)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nblk // (bpm * n_members),),
+        in_specs=[mspec(s) for s in range(n_members)],
+        out_specs=ospec,
+    )
+    return pl.pallas_call(
+        functools.partial(_relayout_cross_kernel, n_members=n_members,
+                          bpm=bpm),
+        out_shape=jax.ShapeDtypeStruct((nblk, s_rows, LANES), jnp.int32),
+        grid_spec=grid_spec,
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
+        interpret=interpret,
+    )
+
+
+def _rot_merge_kernel(s_ref, *refs, n_members: int, s_rows: int,
+                      b_log2: int, tail: int, bpm: int):
+    """Key-only :func:`_rot_merge_pair_kernel`: ``n_members = 2^tail``
+    gathered through the stage's accumulated rotation, cross ladder +
+    full sweep, natural-order contiguous write."""
+    j_bits = s_ref[0]
+    lb = bpm.bit_length() - 1
+    g = pl.program_id(0)
+    desc = ((g >> (j_bits - tail - lb)) & 1) == 1
+    o_ref = refs[n_members]
+    for b in range(bpm):
+        ks = [jnp.where(desc, ~refs[i][b], refs[i][b])
+              for i in range(n_members)]
+        _min_max_ladder(ks, tail)
+        for i in range(n_members):
+            k = _sweep(ks[i], b_log2)
+            o_ref[b * n_members + i] = jnp.where(desc, ~k, k)
+
+
+@functools.lru_cache(maxsize=16)
+def _compile_rot_merge(nblk: int, s_rows: int, b_log2: int, tail: int,
+                       interpret: bool, bpm: int = 2):
+    """Key-only :func:`_compile_rot_merge_pair` with a ``2^tail``-member
+    group (the 1-word engine fuses three cross bits into its merge)."""
+    n_members = 1 << tail
+    lb = bpm.bit_length() - 1
+
+    def member_map(s):
+        def f(g, s_ref):
+            j_w = s_ref[0] - lb
+            wbits = j_w - tail
+            seg = g >> wbits
+            w = g & ((1 << wbits) - 1)
+            return ((seg << j_w) + (s << wbits) + w, _Z, _Z)
+        return f
+
+    mspec = lambda s: pl.BlockSpec((bpm, s_rows, LANES), member_map(s),
+                                   memory_space=pltpu.VMEM)
+    ospec = pl.BlockSpec((bpm * n_members, s_rows, LANES),
+                         lambda g, s: (g, _Z, _Z),
+                         memory_space=pltpu.VMEM)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nblk // (bpm * n_members),),
+        in_specs=[mspec(s) for s in range(n_members)],
+        out_specs=ospec,
+    )
+    return pl.pallas_call(
+        functools.partial(_rot_merge_kernel, n_members=n_members,
+                          s_rows=s_rows, b_log2=b_log2, tail=tail, bpm=bpm),
+        out_shape=jax.ShapeDtypeStruct((nblk, s_rows, LANES), jnp.int32),
+        grid_spec=grid_spec,
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
+        interpret=interpret,
+    )
 
 
 # ------------------------------------------------------- key+payload twin
@@ -783,59 +929,66 @@ def _compile_relayout_cross_pair(n_members: int, nblk: int, s_rows: int,
 
 
 def _rot_merge_pair_kernel(s_ref, *refs, n_members: int, s_rows: int,
-                           b_log2: int):
+                           b_log2: int, bpm: int):
     """:func:`_merge_pair_kernel` with gather inputs: member ``s`` was
     read through the stage's accumulated rotation, so the body is the
     identical cross-tail + sweep; the block id used for the stage
-    direction is the segment bit, shared by all members."""
+    direction is the segment bit, shared by all members.  ``bpm``
+    consecutive rotation groups ride per window (same DMA-width trade
+    as the visits)."""
     j_bits = s_ref[0]
+    lb = bpm.bit_length() - 1
     g = pl.program_id(0)
-    desc = ((g >> (j_bits - 2)) & 1) == 1
-    ks = [jnp.where(desc, ~refs[i][0], refs[i][0]) for i in range(n_members)]
-    ps = [refs[n_members + i][0] for i in range(n_members)]
+    desc = ((g >> (j_bits - 2 - lb)) & 1) == 1
     ok_ref, op_ref = refs[2 * n_members], refs[2 * n_members + 1]
-    _closure_ladder(ks, ps, n_members.bit_length() - 1)
-    for i in range(n_members):
-        k, p = _sweep_pair(ks[i], ps[i], b_log2)
-        ok_ref[i] = jnp.where(desc, ~k, k)
-        op_ref[i] = p
+    for b in range(bpm):
+        ks = [jnp.where(desc, ~refs[i][b], refs[i][b])
+              for i in range(n_members)]
+        ps = [refs[n_members + i][b] for i in range(n_members)]
+        _closure_ladder(ks, ps, n_members.bit_length() - 1)
+        for i in range(n_members):
+            k, p = _sweep_pair(ks[i], ps[i], b_log2)
+            ok_ref[b * n_members + i] = jnp.where(desc, ~k, k)
+            op_ref[b * n_members + i] = p
 
 
 @functools.lru_cache(maxsize=16)
 def _compile_rot_merge_pair(nblk: int, s_rows: int, b_log2: int,
-                            interpret: bool):
+                            interpret: bool, bpm: int = 2):
     """Stage-final merge reading through the accumulated rotation: after
     the visits consumed logical bits J-1..2, the remaining logical bits
     (1, 0) sit at the TOP of the physical index — member ``s`` of
-    logical group ``h`` lives at phys ``(seg << J) + (s << (J-2)) + h``.
+    logical group ``h`` lives at phys ``(seg << J) + (s << (J-2)) + h``
+    (consecutive h adjacent, so ``bpm`` groups share one window).
     Writes natural logical order (contiguous groups of 4), closing the
     stage's permutation."""
     n_members = 4
+    lb = bpm.bit_length() - 1
 
     def member_map(s):
         def f(g, s_ref):
-            j_bits = s_ref[0]
-            hbits = j_bits - 2
-            seg = g >> hbits
-            h = g & ((1 << hbits) - 1)
-            return ((seg << j_bits) + (s << hbits) + h, _Z, _Z)
+            j_w = s_ref[0] - lb
+            wbits = j_w - 2
+            seg = g >> wbits
+            w = g & ((1 << wbits) - 1)
+            return ((seg << j_w) + (s << wbits) + w, _Z, _Z)
         return f
 
-    mspec = lambda s: pl.BlockSpec((1, s_rows, LANES), member_map(s),
+    mspec = lambda s: pl.BlockSpec((bpm, s_rows, LANES), member_map(s),
                                    memory_space=pltpu.VMEM)
-    ospec = pl.BlockSpec((n_members, s_rows, LANES),
+    ospec = pl.BlockSpec((bpm * n_members, s_rows, LANES),
                          lambda g, s: (g, _Z, _Z),
                          memory_space=pltpu.VMEM)
     shape = jax.ShapeDtypeStruct((nblk, s_rows, LANES), jnp.int32)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(nblk // n_members,),
+        grid=(nblk // (bpm * n_members),),
         in_specs=[mspec(s) for s in range(n_members)] * 2,
         out_specs=[ospec, ospec],
     )
     return pl.pallas_call(
         functools.partial(_rot_merge_pair_kernel, n_members=n_members,
-                          s_rows=s_rows, b_log2=b_log2),
+                          s_rows=s_rows, b_log2=b_log2, bpm=bpm),
         out_shape=[shape, shape],
         grid_spec=grid_spec,
         compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),

@@ -24,9 +24,10 @@ def local_sort(words: Words, engine: str = "lax") -> Words:
     lexicographically — this is how 64-bit keys sort without x64.
 
     ``engine="bitonic"`` routes one-word keys through the Pallas bitonic
-    engine (``ops/bitonic.py``, 1.64x ``lax.sort`` at 2^28 on v5e) and
-    two-word keys through the pair engine (+ on-device residual-cond
-    fallback; 1.41x the variadic ``lax.sort`` at 2^26 measured) —
+    engine (``ops/bitonic.py``, 2.0-4.2x ``lax.sort`` at 2^26 on v5e
+    post-relayout) and two-word keys through the pair engine (+
+    on-device residual-cond fallback; 1.54-2.30x the variadic
+    ``lax.sort`` at 2^26, clean sessions at the top of the band) —
     including under ``shard_map``, which is how the distributed sample
     sort accelerates its per-shard sorts on real TPU meshes.
     ``engine="bitonic_interpret"`` runs the same kernels through the
@@ -126,7 +127,7 @@ def _fix_boundary(hi: jax.Array, lo: jax.Array, passes: int,
 
 
 def sort_two_words_bitonic(hi: jax.Array, lo: jax.Array,
-                           interpret: bool = False, fix_passes: int = 8):
+                           interpret: bool = False, fix_passes: int = 16):
     """64-bit local sort via the pair bitonic engine — the MSD-hybrid
     structure VERDICT r3 #1 asked for, in its measured-optimal form.
 
@@ -140,6 +141,13 @@ def sort_two_words_bitonic(hi: jax.Array, lo: jax.Array,
     ``fix_passes`` (heavy hi duplication — the caller's sniff makes this
     rare) set the residual flag; output is then NOT fully sorted and the
     caller must fall back to the variadic ``lax.sort``.
+
+    Depth priced on chip at 2^26 (``bench/fixdepth_probe.py``, r5 —
+    every phase is oblivious, so the uniform row prices all inputs):
+    8 -> 16 passes costs +2.2% always and moves the sniff-evading
+    runs-9..16 class from the 279 ms double-sort to the 102 ms in-VMEM
+    path (2.7x); 16 -> 32 costs +9% always for the narrower 17..32
+    class.  16 is the shipped default (VERDICT r4 weak #3 mid-tier).
 
     Returns ``(hi_sorted, lo_sorted, residual)``.
     """

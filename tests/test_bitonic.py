@@ -21,6 +21,7 @@ def _rand(n, rng):
     return rng.integers(0, 1 << 32, n, dtype=np.uint32)
 
 
+@pytest.mark.parametrize("relayout", [True, False])
 @pytest.mark.parametrize(
     "n_log2,b_log2",
     [
@@ -29,13 +30,16 @@ def _rand(n, rng):
         (13, 10),   # 8 blocks: merge stages, no cross layers
         (15, 11),   # 16 blocks: one grouped cross layer
         (16, 11),   # 32 blocks: cross layers at two distances
+        (18, 11),   # nbits up to 7: 8-member visits + 1/2-bit remainders
     ],
 )
-def test_sort_padded(n_log2, b_log2):
+def test_sort_padded(n_log2, b_log2, relayout):
+    """Both cross schedules (round-5 relayout default and the round-4
+    grouped-cross A/B baseline), incl. the 3-bit visit path."""
     rng = np.random.default_rng(n_log2 * 31 + b_log2)
     x = _rand(1 << n_log2, rng)
     out = bitonic.sort_padded(jnp.asarray(x), 1 << n_log2, b_log2,
-                              interpret=True)
+                              interpret=True, relayout=relayout)
     np.testing.assert_array_equal(np.asarray(out), np.sort(x))
 
 

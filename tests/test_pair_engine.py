@@ -83,18 +83,34 @@ def test_heavy_hi_duplication_reroutes(mesh1, rng, monkeypatch):
     assert tracer.counters.get("pair_dup_reroute") == 1
 
 
-def test_mid_runs_residual_fallback(mesh1, rng, monkeypatch):
-    """Runs of 16 equal-hi keys — longer than the 8-pass fix-up covers.
-    At test scale the 1024-key sniff actually catches this (958 distinct
-    values cannot survive a 1024-sample without collision), so the miss
-    is forced by stubbing the sniff: the residual flag must fire and the
-    fallback must still return exact bytes — correctness must never
-    depend on the sniff's sensitivity."""
+def test_mid_runs_in_vmem_fix(mesh1, rng, monkeypatch):
+    """Runs of 16 equal-hi keys — the class that used to double-sort via
+    the residual fallback now rides the 16-pass in-VMEM fix-up (the
+    round-5 mid-tier, priced in bench/fixdepth_probe.py): exact output,
+    NO fallback."""
     from mpitest_tpu.models import api
 
     monkeypatch.setattr(api, "_host_hi_dup_sniff", lambda hi: False)
     n_runs = -(-N // 16)
     hi = np.repeat(np.arange(n_runs, dtype=np.int64) * 37 + 5, 16)[:N]
+    x = (hi << 32) | rng.integers(0, 2**32, size=N).astype(np.int64)
+    rng.shuffle(x)
+    tracer = _run(x, mesh1, monkeypatch)
+    assert tracer.counters["local_engine"] == "bitonic_pair"
+    assert "pair_residual_fallback" not in tracer.counters
+
+
+def test_mid_runs_residual_fallback(mesh1, rng, monkeypatch):
+    """Runs of 24 equal-hi keys — longer than the 16-pass fix-up covers.
+    At test scale the 1024-key sniff could catch this, so the miss is
+    forced by stubbing the sniff: the residual flag must fire and the
+    fallback must still return exact bytes — correctness must never
+    depend on the sniff's sensitivity."""
+    from mpitest_tpu.models import api
+
+    monkeypatch.setattr(api, "_host_hi_dup_sniff", lambda hi: False)
+    n_runs = -(-N // 24)
+    hi = np.repeat(np.arange(n_runs, dtype=np.int64) * 37 + 5, 24)[:N]
     x = (hi << 32) | rng.integers(0, 2**32, size=N).astype(np.int64)
     rng.shuffle(x)  # runs exist in key space, not in input order
     tracer = _run(x, mesh1, monkeypatch)
@@ -110,12 +126,12 @@ from hypothesis import given, settings, strategies as st  # noqa: E402
 @given(data=st.data())
 def test_property_sort_two_words_contract(data):
     """For ARBITRARY run profiles (run lengths 1..24 — straddling the
-    8-pass fix-up threshold both ways — random lo, shuffled input,
+    16-pass fix-up threshold both ways — random lo, shuffled input,
     non-power-of-two n): sort_two_words_bitonic either returns the
     exact lexicographic sort with residual=False, or residual=True;
     the pair multiset is preserved in every case, and residual=False
-    is GUARANTEED when all runs are <= 8.  The correctness contract
-    the api fallback relies on."""
+    is GUARANTEED when all runs are <= fix_passes (16).  The
+    correctness contract the api fallback relies on."""
     import jax.numpy as jnp
 
     from mpitest_tpu.ops import bitonic, kernels
@@ -147,7 +163,7 @@ def test_property_sort_two_words_contract(data):
     key_in = (hi.astype(np.uint64) << 32) | lo
     key_out = (hs.astype(np.uint64) << 32) | ls
     np.testing.assert_array_equal(np.sort(key_out), np.sort(key_in))
-    if max(lens) <= 8:
+    if max(lens) <= 16:  # the round-5 default fix depth
         assert not bad
     if not bad:
         np.testing.assert_array_equal(key_out, np.sort(key_in))

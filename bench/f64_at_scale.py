@@ -71,14 +71,20 @@ def main() -> int:
     @jax.jit
     def sort_words(h, l):
         hs, ls, bad = kernels.sort_two_words_bitonic(h, l)
-        return jax.lax.cond(
+        hs, ls = jax.lax.cond(
             bad,
             lambda a, b: tuple(jax.lax.sort([a, b], num_keys=2,
                                             is_stable=False)),
             lambda a, b: (hs, ls), h, l)
+        return hs, ls, bad
 
-    # Warmup (compile) + probe.
-    hs, ls = sort_words(hi, lo)
+    # Warmup (compile) + probe; `residual` records which route the
+    # timed runs take (False = pair network, True = lax fallback), so
+    # the JSONL row carries its route like every other round-5 row.
+    hs, ls, bad = sort_words(hi, lo)
+    residual = bool(jax.device_get(bad))
+    print(f"route: {'lax fallback (residual)' if residual else 'pair network'}",
+          flush=True)
     got = ((int(jax.device_get(hs[n // 2 - 1])) << 32)
            | int(jax.device_get(ls[n // 2 - 1])))
     ok = got == ref_median
@@ -88,7 +94,7 @@ def main() -> int:
     times = []
     for i in range(repeats):
         t0 = time.perf_counter()
-        hs, ls = sort_words(hi, lo)
+        hs, ls, _ = sort_words(hi, lo)
         jax.device_get(hs[-1:])
         dt = time.perf_counter() - t0
         times.append(dt)
@@ -105,6 +111,7 @@ def main() -> int:
            "config": f"tpu_f64_words_2e{log2n}_device_resident",
            "metric": "mkeys_per_s", "value": round(mkeys, 1),
            "median_ok": ok, "decoded_monotone": mono,
+           "route": "lax_fallback" if residual else "bitonic_pair",
            "span": "device_words", "host_encode_s": round(enc_s, 2)}
     with open(RESULTS, "a") as f:
         f.write(json.dumps(row) + "\n")

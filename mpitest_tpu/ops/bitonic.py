@@ -742,12 +742,16 @@ def _compile_merge_pair(n_members: int, nblk: int, s_rows: int, b_log2: int,
                           s_rows=s_rows, b_log2=b_log2),
         out_shape=[shape, shape],
         grid_spec=grid_spec,
+        # Raised budget: the 8-member shape (tail_bits=3 experiment)
+        # needs 25.6 MiB scoped vmem; no effect on the 2/4-member forms.
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
         interpret=interpret,
     )
 
 
 def sort_pairs_padded(k, p, n_pow2: int, b_log2: int,
-                      interpret: bool = False, relayout: bool = True):
+                      interpret: bool = False, relayout: bool = True,
+                      tail_bits: int | None = None):
     """Bitonic-sort uint32 ``(k, p)`` pairs by the KEY plane only.
 
     Same network as :func:`sort_padded`; the payload plane rides every
@@ -763,6 +767,11 @@ def sort_pairs_padded(k, p, n_pow2: int, b_log2: int,
     keeps the round-4 one-layer-at-a-time cross path (the A/B
     baseline; see BASELINE.md round-5 section).
 
+    ``tail_bits`` (relayout only; 2 or 3): cross bits fused into the
+    stage-final merge.  Default 2; 3 trades 4 closure visits for
+    8-member merges — measured session-dependent (see the tail
+    selection comment below), kept for pricing.
+
     Returns ``(k_sorted, p_permuted)``, both flat uint32 [n_pow2].
     """
     t = n_pow2.bit_length() - 1
@@ -776,7 +785,24 @@ def sort_pairs_padded(k, p, n_pow2: int, b_log2: int,
 
     kb, pb = _compile_block_sort_pair(nblk, s_rows, b_log2, interpret)(kb, pb)
 
-    tail = _PAIR_MERGE_BITS  # log2(_PAIR_CROSS_GROUP): merge's cross share
+    # Merge tail width: 2 stays the shipped default.  The 3-bit tail
+    # (8-member rot-merge at bpm=1; drops 4 closure visits at 2^26)
+    # was priced same-process on chip across three sessions and
+    # STRADDLES parity: 1.08x and 1.29x faster through degraded
+    # tunnels (fewer kernels -> less per-kernel overhead), 0.97x
+    # (slower) in a clean session where the 4-member merge pipelines
+    # better.  Clean sessions are the headline regime, so tail=2
+    # ships; ``tail_bits=3`` remains available and tested.
+    if tail_bits is not None:
+        if not relayout:
+            raise ValueError("tail_bits applies to the relayout schedule "
+                             "only (the r4 path keeps its 2-bit tail)")
+        if tail_bits not in (2, 3):
+            raise ValueError(f"tail_bits={tail_bits!r}: supported widths "
+                             "are 2 and 3 (wider 2^tail-member merges "
+                             "exceed the scoped-vmem budget)")
+    tail = tail_bits if (relayout and tail_bits is not None) \
+        else _PAIR_MERGE_BITS
     cross = (None if relayout else
              (_compile_cross_pair(nblk, s_rows, interpret)
               if t > b_log2 + tail else None))
@@ -795,8 +821,11 @@ def sort_pairs_padded(k, p, n_pow2: int, b_log2: int,
                                                   bpm=2)
             for _ in range(n_single // 2):
                 kb, pb = visit2(jarr, *([kb] * 4), *([pb] * 4))
-            kb, pb = _compile_rot_merge_pair(nblk, s_rows, b_log2, interpret)(
-                jarr, *([kb] * 4), *([pb] * 4))
+            nm = 1 << tail
+            kb, pb = _compile_rot_merge_pair(
+                nblk, s_rows, b_log2, interpret, tail=tail,
+                bpm=2 if tail == 2 else 1)(
+                jarr, *([kb] * nm), *([pb] * nm))
             continue
         for sj in range(nbits - 1, tail - 1, -1):
             kb, pb = cross(jnp.asarray([sj - tail, nbits], jnp.int32),
@@ -929,23 +958,23 @@ def _compile_relayout_cross_pair(n_members: int, nblk: int, s_rows: int,
 
 
 def _rot_merge_pair_kernel(s_ref, *refs, n_members: int, s_rows: int,
-                           b_log2: int, bpm: int):
+                           b_log2: int, tail: int, bpm: int):
     """:func:`_merge_pair_kernel` with gather inputs: member ``s`` was
     read through the stage's accumulated rotation, so the body is the
     identical cross-tail + sweep; the block id used for the stage
     direction is the segment bit, shared by all members.  ``bpm``
     consecutive rotation groups ride per window (same DMA-width trade
-    as the visits)."""
+    as the visits); ``n_members = 2^tail``."""
     j_bits = s_ref[0]
     lb = bpm.bit_length() - 1
     g = pl.program_id(0)
-    desc = ((g >> (j_bits - 2 - lb)) & 1) == 1
+    desc = ((g >> (j_bits - tail - lb)) & 1) == 1
     ok_ref, op_ref = refs[2 * n_members], refs[2 * n_members + 1]
     for b in range(bpm):
         ks = [jnp.where(desc, ~refs[i][b], refs[i][b])
               for i in range(n_members)]
         ps = [refs[n_members + i][b] for i in range(n_members)]
-        _closure_ladder(ks, ps, n_members.bit_length() - 1)
+        _closure_ladder(ks, ps, tail)
         for i in range(n_members):
             k, p = _sweep_pair(ks[i], ps[i], b_log2)
             ok_ref[b * n_members + i] = jnp.where(desc, ~k, k)
@@ -954,21 +983,21 @@ def _rot_merge_pair_kernel(s_ref, *refs, n_members: int, s_rows: int,
 
 @functools.lru_cache(maxsize=16)
 def _compile_rot_merge_pair(nblk: int, s_rows: int, b_log2: int,
-                            interpret: bool, bpm: int = 2):
+                            interpret: bool, tail: int = 2, bpm: int = 2):
     """Stage-final merge reading through the accumulated rotation: after
-    the visits consumed logical bits J-1..2, the remaining logical bits
-    (1, 0) sit at the TOP of the physical index — member ``s`` of
-    logical group ``h`` lives at phys ``(seg << J) + (s << (J-2)) + h``
-    (consecutive h adjacent, so ``bpm`` groups share one window).
-    Writes natural logical order (contiguous groups of 4), closing the
-    stage's permutation."""
-    n_members = 4
+    the visits consumed logical bits J-1..tail, the remaining logical
+    bits (tail-1..0) sit at the TOP of the physical index — member
+    ``s`` of logical group ``h`` lives at phys
+    ``(seg << J) + (s << (J-tail)) + h`` (consecutive h adjacent, so
+    ``bpm`` groups share one window).  Writes natural logical order
+    (contiguous groups of 2^tail), closing the stage's permutation."""
+    n_members = 1 << tail
     lb = bpm.bit_length() - 1
 
     def member_map(s):
         def f(g, s_ref):
             j_w = s_ref[0] - lb
-            wbits = j_w - 2
+            wbits = j_w - tail
             seg = g >> wbits
             w = g & ((1 << wbits) - 1)
             return ((seg << j_w) + (s << wbits) + w, _Z, _Z)
@@ -988,7 +1017,7 @@ def _compile_rot_merge_pair(nblk: int, s_rows: int, b_log2: int,
     )
     return pl.pallas_call(
         functools.partial(_rot_merge_pair_kernel, n_members=n_members,
-                          s_rows=s_rows, b_log2=b_log2, bpm=bpm),
+                          s_rows=s_rows, b_log2=b_log2, tail=tail, bpm=bpm),
         out_shape=[shape, shape],
         grid_spec=grid_spec,
         compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),

@@ -82,6 +82,21 @@ def test_sort_pairs_padded(n_log2, b_log2, span, relayout):
     _check_pairs(k, p, np.asarray(ks), np.asarray(ps))
 
 
+@pytest.mark.parametrize("n_log2,b_log2", [(13, 10), (16, 11)])
+def test_sort_pairs_padded_tail3(n_log2, b_log2):
+    """The 3-bit merge tail (8-member rot-merge + 8-member contiguous
+    merge at nbits=3) — priced on chip as session-dependent (BASELINE.md
+    round 5), kept available behind ``tail_bits=3``."""
+    rng = np.random.default_rng(n_log2)
+    n = 1 << n_log2
+    k = rng.integers(0, 1 << 32, n, dtype=np.uint32)
+    p = rng.integers(0, 1 << 32, n, dtype=np.uint32)
+    ks, ps = bitonic.sort_pairs_padded(jnp.asarray(k), jnp.asarray(p),
+                                       n, b_log2, interpret=True,
+                                       tail_bits=3)
+    _check_pairs(k, p, np.asarray(ks), np.asarray(ps))
+
+
 def test_fix_runs_pairs_kernel_and_boundary():
     """The in-VMEM run-fix kernel + XLA boundary strip must sort lo
     within every equal-hi run of length <= passes — including runs that

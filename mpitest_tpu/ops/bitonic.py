@@ -93,12 +93,14 @@ _PAIR_CROSS_GROUP = 4      # blocks per pair cross-layer transfer group
 _PAIR_MERGE_BITS = 2       # cross bits fused into the pair merge tail
 #: blocks per cross-layer transfer group (see ``_cross_kernel``).
 _CROSS_GROUP = 8
-#: Raised scoped-VMEM budget for the round-5 relayout kernels.  The
-#: 16 MiB default is a compiler parameter, not hardware (v5e VMEM is
-#: 128 MiB); 48 MiB admits the wide shapes round 4 recorded as walls
-#: (2-block member windows, the 25.6 MiB 8-member pair merge) while
-#: leaving ample room for the pipeline's double buffers.
+#: Raised scoped-VMEM budget, applied to EVERY kernel in this module.
+#: The 16 MiB default is a compiler parameter, not hardware (v5e VMEM
+#: is 128 MiB); 48 MiB admits the wide shapes round 4 recorded as
+#: walls (2-block member windows, the 25.6 MiB 8-member pair merge,
+#: the B=17 block experiment) while leaving ample room for the
+#: pipeline's double buffers.
 _VMEM_LIMIT = 48 * 1024 * 1024
+_COMPILER_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT)
 
 #: Index-map constants pinned to int32: under jax_enable_x64 (the
 #: device-resident 64-bit path) Python-int literals in index maps
@@ -304,6 +306,9 @@ def _compile_block_sort(nblk: int, s_rows: int, b_log2: int, interpret: bool):
         out_specs=spec,
         # No aliasing: in-place measured ~1.5x slower (12.9 vs 8.5 ms at
         # 2^26) — same defensive-copy/pipelining penalty as the merge.
+        # Raised budget: admits the B=17 block experiment (the unrolled
+        # chain holds ~34 live block copies); no effect at B=16.
+        compiler_params=_COMPILER_PARAMS,
         interpret=interpret,
     )
 
@@ -340,6 +345,7 @@ def _compile_cross(nblk: int, s_rows: int, interpret: bool):
         _cross_kernel,
         out_shape=jax.ShapeDtypeStruct((nblk, s_rows, LANES), jnp.int32),
         grid_spec=grid_spec,
+        compiler_params=_COMPILER_PARAMS,
         interpret=interpret,
     )
 
@@ -360,6 +366,9 @@ def _compile_merge(n_members: int, nblk: int, s_rows: int, b_log2: int,
                           b_log2=b_log2),
         out_shape=jax.ShapeDtypeStruct((nblk, s_rows, LANES), jnp.int32),
         grid_spec=grid_spec,
+        # Raised budget (see _VMEM_LIMIT): the 8-member window at B=17
+        # needs 28.3 MiB; no effect on the shipped B=16 shapes.
+        compiler_params=_COMPILER_PARAMS,
         # No input_output_aliases here although each grid step reads only
         # the group it writes: in-place was measured 3.3x SLOWER at 2^30
         # (11.1 s vs 3.4 s end-to-end — XLA inserts defensive copies /
@@ -500,7 +509,7 @@ def _compile_relayout_cross(n_members: int, nblk: int, s_rows: int,
                           bpm=bpm),
         out_shape=jax.ShapeDtypeStruct((nblk, s_rows, LANES), jnp.int32),
         grid_spec=grid_spec,
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
+        compiler_params=_COMPILER_PARAMS,
         interpret=interpret,
     )
 
@@ -557,7 +566,7 @@ def _compile_rot_merge(nblk: int, s_rows: int, b_log2: int, tail: int,
                           s_rows=s_rows, b_log2=b_log2, tail=tail, bpm=bpm),
         out_shape=jax.ShapeDtypeStruct((nblk, s_rows, LANES), jnp.int32),
         grid_spec=grid_spec,
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
+        compiler_params=_COMPILER_PARAMS,
         interpret=interpret,
     )
 
@@ -691,6 +700,7 @@ def _compile_block_sort_pair(nblk: int, s_rows: int, b_log2: int,
         grid=(nblk,),
         in_specs=[spec, spec],
         out_specs=[spec, spec],
+        compiler_params=_COMPILER_PARAMS,
         interpret=interpret,
     )
 
@@ -721,6 +731,7 @@ def _compile_cross_pair(nblk: int, s_rows: int, interpret: bool):
         _cross_pair_kernel,
         out_shape=[shape, shape],
         grid_spec=grid_spec,
+        compiler_params=_COMPILER_PARAMS,
         interpret=interpret,
     )
 
@@ -744,7 +755,7 @@ def _compile_merge_pair(n_members: int, nblk: int, s_rows: int, b_log2: int,
         grid_spec=grid_spec,
         # Raised budget: the 8-member shape (tail_bits=3 experiment)
         # needs 25.6 MiB scoped vmem; no effect on the 2/4-member forms.
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
+        compiler_params=_COMPILER_PARAMS,
         interpret=interpret,
     )
 
@@ -952,7 +963,7 @@ def _compile_relayout_cross_pair(n_members: int, nblk: int, s_rows: int,
                           bpm=bpm),
         out_shape=[shape, shape],
         grid_spec=grid_spec,
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
+        compiler_params=_COMPILER_PARAMS,
         interpret=interpret,
     )
 
@@ -1020,7 +1031,7 @@ def _compile_rot_merge_pair(nblk: int, s_rows: int, b_log2: int,
                           s_rows=s_rows, b_log2=b_log2, tail=tail, bpm=bpm),
         out_shape=[shape, shape],
         grid_spec=grid_spec,
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
+        compiler_params=_COMPILER_PARAMS,
         interpret=interpret,
     )
 
@@ -1085,6 +1096,7 @@ def _compile_fix_runs(nblk: int, s_rows: int, passes: int, interpret: bool):
         grid=(nblk,),
         in_specs=[spec, spec],
         out_specs=spec,
+        compiler_params=_COMPILER_PARAMS,
         interpret=interpret,
     )
 

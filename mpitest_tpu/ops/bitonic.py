@@ -42,6 +42,11 @@ Design (tpu-first, not a port of any CPU/GPU radix scheme):
 - Compare distances and stage numbers ride in as scalar-prefetch
   operands (``PrefetchScalarGridSpec``), so each kernel compiles
   **once** per array shape, not once per layer.
+- Round 5 replaced the single-cross schedule with the **rotation
+  relayout** (see the "relayout cross fusion" section below): fused
+  XOR-closure visits + rotation-aware merges, 2-3x fewer HBM bytes
+  for the cross phase; the r4 schedule stays available as the A/B
+  baseline (``relayout=False``).
 
 The network is oblivious (layer sequence depends only on N), so output
 is deterministic and bit-identical run to run — the same canonical
@@ -851,11 +856,13 @@ def sort_pairs_padded(k, p, n_pow2: int, b_log2: int,
 
 # ------------------------------------------- relayout cross fusion (r5)
 #
-# The round-4 phase split put 56% of the pair network in its 36 single
-# cross layers: each one reads the whole array TWICE (both sides of the
-# pair, so one output array receives every group) and writes it once —
-# 3n traffic per layer, measured 1.89 ms against a 0.75 ms streaming
-# floor at 2^26.  The wall named in BASELINE.md: consecutive cross
+# The round-4 phase split attributed 56% of the pair network to its 36
+# single cross layers (round 5's partial-network attribution corrected
+# that to ~44% — BASELINE.md — but they were the biggest addressable
+# phase either way): each one reads the whole array TWICE (both sides
+# of the pair, so one output array receives every group) and writes it
+# once — 3n traffic per layer, measured 1.89 ms against a 0.75 ms
+# streaming floor at 2^26.  The wall named in BASELINE.md: consecutive cross
 # layers at block bits (j, j-1) form 4-way XOR-closures whose members
 # are NOT contiguous, and a pallas grid step cannot write 4 scattered
 # windows of one output array.

@@ -9,11 +9,15 @@
 #                  segment_pack, the 5-pattern adversarial battery; one
 #                  JSONL row appended to bench/BASELINE_RESULTS.jsonl.
 #                  Finishes in minutes — run it in every chip session.
+#   make telemetry-selftest — end-to-end check of the unified telemetry
+#                  layer: a tiny TPU-path sort with SORT_TRACE (span
+#                  JSONL) + a native run with COMM_STATS, both validated
+#                  by `python -m mpitest_tpu.report --check`
 #   make clean   — remove all build artifacts
 
 PYTHON ?= python3
 
-.PHONY: test native chip-test clean
+.PHONY: test native chip-test telemetry-selftest clean
 
 chip-test:
 	$(PYTHON) -u bench/chip_regression.py
@@ -26,6 +30,28 @@ native:
 	$(MAKE) -C mpi_radix_sort BACKEND=local
 	$(MAKE) -C bench BACKEND=local
 	$(MAKE) -C bench mpi-syntax-check
+
+# One-command proof that both telemetry producers emit what the report
+# CLI can validate: TPU span stream (SORT_TRACE) on a virtual CPU mesh
+# + native COMM_STATS from a pthreads sort, same tiny input.
+TELEMETRY_TMP := /tmp/mpitest_telemetry_selftest
+telemetry-selftest:
+	$(MAKE) -C mpi_radix_sort BACKEND=local
+	rm -rf $(TELEMETRY_TMP) && mkdir -p $(TELEMETRY_TMP)
+	$(PYTHON) -c "import numpy as np; np.savetxt('$(TELEMETRY_TMP)/keys.txt', \
+	    np.random.default_rng(0).integers(-2**31, 2**31-1, size=4096, \
+	    dtype=np.int32), fmt='%d')"
+	JAX_PLATFORMS=cpu \
+	    XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+	    SORT_ALGO=radix SORT_RANKS=4 \
+	    SORT_TRACE=$(TELEMETRY_TMP)/trace.jsonl \
+	    $(PYTHON) drivers/sort_cli.py $(TELEMETRY_TMP)/keys.txt
+	COMM_RANKS=4 COMM_STATS=$(TELEMETRY_TMP)/comm_stats.jsonl \
+	    mpi_radix_sort/radix_sort $(TELEMETRY_TMP)/keys.txt
+	$(PYTHON) -m mpitest_tpu.report --check \
+	    $(TELEMETRY_TMP)/trace.jsonl $(TELEMETRY_TMP)/comm_stats.jsonl
+	$(PYTHON) -m mpitest_tpu.report \
+	    $(TELEMETRY_TMP)/trace.jsonl $(TELEMETRY_TMP)/comm_stats.jsonl
 
 clean:
 	$(MAKE) -C mpi_sample_sort clean

@@ -18,6 +18,7 @@
  */
 #define _GNU_SOURCE
 #include "comm.h"
+#include "comm_stats.h"
 
 #include <pthread.h>
 #include <stdio.h>
@@ -35,6 +36,10 @@ typedef struct world {
     int nranks;
     pthread_barrier_t bar;
     slot_t *slots;            /* [nranks] */
+    /* COMM_STATS telemetry (comm_stats.h): one table per rank, written
+     * lock-free by its owner thread, folded + dumped by the launcher.
+     * NULL when COMM_STATS is unset — collectives then pay one branch. */
+    comm_stat_t (*stats)[COMM_ST_N];
 } world_t;
 
 struct comm_ctx {
@@ -64,74 +69,105 @@ void comm_abort(comm_ctx *c, int code, const char *msg) {
     exit(code ? code : 1); /* whole process: all ranks die (MPI_Abort) */
 }
 
-void comm_barrier(comm_ctx *c) { pthread_barrier_wait(&c->w->bar); }
+/* Internal barrier (the two-epoch publish/copy fences): NOT counted in
+ * COMM_STATS — the enclosing collective's timer already covers it, and
+ * counting it would bill every collective as two extra barriers. */
+static void bar(comm_ctx *c) { pthread_barrier_wait(&c->w->bar); }
+
+/* Telemetry shims: t0 sentinel < 0 means stats off (no clock calls). */
+static double st_begin(const comm_ctx *c) {
+    return c->w->stats ? comm_stats_now() : -1.0;
+}
+
+static void st_end(comm_ctx *c, int which, size_t bytes, double t0) {
+    if (t0 >= 0.0)
+        comm_stats_add(c->w->stats[c->rank], which, bytes,
+                       comm_stats_now() - t0);
+}
+
+void comm_barrier(comm_ctx *c) {
+    double t0 = st_begin(c);
+    bar(c);
+    st_end(c, COMM_ST_BARRIER, 0, t0);
+}
 
 static slot_t *my_slot(comm_ctx *c) { return &c->w->slots[c->rank]; }
 
 void comm_bcast(comm_ctx *c, void *buf, size_t bytes, int root) {
+    double t0 = st_begin(c);
     if (c->rank == root) my_slot(c)->ptr = buf;
-    comm_barrier(c);
+    bar(c);
     if (c->rank != root) memcpy(buf, c->w->slots[root].ptr, bytes);
-    comm_barrier(c);
+    bar(c);
+    st_end(c, COMM_ST_BCAST, bytes, t0);
 }
 
 void comm_scatter(comm_ctx *c, const void *send, void *recv, size_t bytes,
                   int root) {
+    double t0 = st_begin(c);
     if (c->rank == root) my_slot(c)->ptr = send;
-    comm_barrier(c);
+    bar(c);
     const char *base = (const char *)c->w->slots[root].ptr;
     memcpy(recv, base + (size_t)c->rank * bytes, bytes);
-    comm_barrier(c);
+    bar(c);
+    st_end(c, COMM_ST_SCATTER, bytes, t0);
 }
 
 void comm_scatterv(comm_ctx *c, const void *send, const size_t *counts,
                    const size_t *displs, void *recv, size_t recv_bytes,
                    int root) {
+    double t0 = st_begin(c);
     if (c->rank == root) {
         my_slot(c)->ptr = send;
         my_slot(c)->counts = counts;
         my_slot(c)->displs = displs;
     }
-    comm_barrier(c);
+    bar(c);
     const slot_t *rs = &c->w->slots[root];
     size_t n = rs->counts[c->rank];
     if (n > recv_bytes)
         comm_abort(c, 1, "comm_scatterv: recv buffer smaller than root's "
                          "published count (truncation would corrupt data)");
     memcpy(recv, (const char *)rs->ptr + rs->displs[c->rank], n);
-    comm_barrier(c);
+    bar(c);
+    st_end(c, COMM_ST_SCATTERV, n, t0);
 }
 
 void comm_gather(comm_ctx *c, const void *send, void *recv, size_t bytes,
                  int root) {
+    double t0 = st_begin(c);
     my_slot(c)->ptr = send;
-    comm_barrier(c);
+    bar(c);
     if (c->rank == root) {
         for (int s = 0; s < c->w->nranks; s++)
             memcpy((char *)recv + (size_t)s * bytes, c->w->slots[s].ptr, bytes);
     }
-    comm_barrier(c);
+    bar(c);
+    st_end(c, COMM_ST_GATHER, bytes, t0);
 }
 
 void comm_gatherv(comm_ctx *c, const void *send, size_t send_bytes,
                   void *recv, const size_t *counts, const size_t *displs,
                   int root) {
+    double t0 = st_begin(c);
     my_slot(c)->ptr = send;
-    (void)send_bytes;
-    comm_barrier(c);
+    bar(c);
     if (c->rank == root) {
         for (int s = 0; s < c->w->nranks; s++)
             memcpy((char *)recv + displs[s], c->w->slots[s].ptr, counts[s]);
     }
-    comm_barrier(c);
+    bar(c);
+    st_end(c, COMM_ST_GATHERV, send_bytes, t0);
 }
 
 void comm_allgather(comm_ctx *c, const void *send, void *recv, size_t bytes) {
+    double t0 = st_begin(c);
     my_slot(c)->ptr = send;
-    comm_barrier(c);
+    bar(c);
     for (int s = 0; s < c->w->nranks; s++)
         memcpy((char *)recv + (size_t)s * bytes, c->w->slots[s].ptr, bytes);
-    comm_barrier(c);
+    bar(c);
+    st_end(c, COMM_ST_ALLGATHER, bytes * (size_t)c->w->nranks, t0);
 }
 
 /* -- typed reductions ------------------------------------------------ */
@@ -172,41 +208,51 @@ static void reduce_fold(void *acc, const void *in, size_t count, comm_type t,
 static void reduce_ranks(comm_ctx *c, const void *send, void *recv,
                          size_t count, comm_type t, comm_op op, int limit) {
     my_slot(c)->ptr = send;
-    comm_barrier(c);
+    bar(c);
     reduce_identity(recv, count, t, op);
     for (int s = 0; s < limit; s++)
         reduce_fold(recv, c->w->slots[s].ptr, count, t, op);
-    comm_barrier(c);
+    bar(c);
 }
 
 void comm_allreduce(comm_ctx *c, const void *send, void *recv, size_t count,
                     comm_type t, comm_op op) {
+    double t0 = st_begin(c);
     reduce_ranks(c, send, recv, count, t, op, c->w->nranks);
+    st_end(c, COMM_ST_ALLREDUCE, count * ((t == COMM_T_U32) ? 4 : 8), t0);
 }
 
 void comm_exscan(comm_ctx *c, const void *send, void *recv, size_t count,
                  comm_type t, comm_op op) {
+    double t0 = st_begin(c);
     reduce_ranks(c, send, recv, count, t, op, c->rank);
+    st_end(c, COMM_ST_EXSCAN, count * ((t == COMM_T_U32) ? 4 : 8), t0);
 }
 
 void comm_alltoall(comm_ctx *c, const void *send, void *recv, size_t bytes) {
+    double t0 = st_begin(c);
     my_slot(c)->ptr = send;
-    comm_barrier(c);
+    bar(c);
     for (int s = 0; s < c->w->nranks; s++)
         memcpy((char *)recv + (size_t)s * bytes,
                (const char *)c->w->slots[s].ptr + (size_t)c->rank * bytes,
                bytes);
-    comm_barrier(c);
+    bar(c);
+    st_end(c, COMM_ST_ALLTOALL, bytes * (size_t)c->w->nranks, t0);
 }
 
 void comm_alltoallv(comm_ctx *c, const void *send, const size_t *scounts,
                     const size_t *sdispls, void *recv, const size_t *rcounts,
                     const size_t *rdispls) {
+    double t0 = st_begin(c);
+    size_t sent = 0;
+    if (t0 >= 0.0)  /* O(P) byte sum only when telemetry is on */
+        for (int p = 0; p < c->w->nranks; p++) sent += scounts[p];
     slot_t *s = my_slot(c);
     s->ptr = send;
     s->counts = scounts;
     s->displs = sdispls;
-    comm_barrier(c);
+    bar(c);
     for (int p = 0; p < c->w->nranks; p++) {
         const slot_t *ps = &c->w->slots[p];
         size_t n = ps->counts[c->rank];
@@ -216,7 +262,8 @@ void comm_alltoallv(comm_ctx *c, const void *send, const size_t *scounts,
         memcpy((char *)recv + rdispls[p],
                (const char *)ps->ptr + ps->displs[c->rank], n);
     }
-    comm_barrier(c);
+    bar(c);
+    st_end(c, COMM_ST_ALLTOALLV, sent, t0);
 }
 
 static void *thread_main(void *va) {
@@ -236,7 +283,13 @@ int comm_launch(void (*fn)(comm_ctx *, void *), void *arg) {
     world_t w;
     w.nranks = nranks;
     w.slots = (slot_t *)calloc((size_t)nranks, sizeof(slot_t));
-    if (!w.slots || pthread_barrier_init(&w.bar, NULL, (unsigned)nranks)) {
+    const char *stats_path = comm_stats_path();
+    w.stats = stats_path
+        ? (comm_stat_t (*)[COMM_ST_N])calloc((size_t)nranks,
+                                             sizeof(*w.stats))
+        : NULL;
+    if (!w.slots || (stats_path && !w.stats)
+        || pthread_barrier_init(&w.bar, NULL, (unsigned)nranks)) {
         fprintf(stderr, "comm_local: init failed\n");
         return 1;
     }
@@ -250,6 +303,15 @@ int comm_launch(void (*fn)(comm_ctx *, void *), void *arg) {
         }
     }
     for (int r = 0; r < nranks; r++) pthread_join(tids[r], NULL);
+    if (w.stats) {
+        /* Fold per-rank tables (sum calls/bytes, max seconds — see
+         * comm_stats.h) and append the one-line JSON record. */
+        comm_stat_t totals[COMM_ST_N] = {{0, 0, 0.0}};
+        for (int r = 0; r < nranks; r++)
+            comm_stats_fold(totals, w.stats[r]);
+        comm_stats_dump(stats_path, "local", nranks, totals);
+        free(w.stats);
+    }
     pthread_barrier_destroy(&w.bar);
     free(tids);
     free(tas);

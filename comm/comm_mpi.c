@@ -13,6 +13,7 @@
  * Counts/displacements: comm.h traffics in size_t bytes; MPI wants int
  * element counts.  We transfer MPI_BYTE and range-check the casts.
  */
+#include "comm_stats.h"   /* first: defines the POSIX feature macro */
 #include "comm.h"
 
 #include <mpi.h>
@@ -43,18 +44,40 @@ void comm_abort(comm_ctx *c, int code, const char *msg) {
     MPI_Abort(MPI_COMM_WORLD, code ? code : 1);
 }
 
-void comm_barrier(comm_ctx *c) { (void)c; MPI_Barrier(MPI_COMM_WORLD); }
+/* COMM_STATS telemetry (comm_stats.h): one rank per process, so the
+ * table is file-static; comm_launch reduces across ranks and rank 0
+ * appends the JSON line before MPI_Finalize. */
+static comm_stat_t g_stats[COMM_ST_N];
+static int g_stats_on;
+
+static double st_begin(void) { return g_stats_on ? MPI_Wtime() : -1.0; }
+
+static void st_end(int which, size_t bytes, double t0) {
+    if (t0 >= 0.0)
+        comm_stats_add(g_stats, which, bytes, MPI_Wtime() - t0);
+}
+
+void comm_barrier(comm_ctx *c) {
+    (void)c;
+    double t0 = st_begin();
+    MPI_Barrier(MPI_COMM_WORLD);
+    st_end(COMM_ST_BARRIER, 0, t0);
+}
 
 void comm_bcast(comm_ctx *c, void *buf, size_t bytes, int root) {
     (void)c;
+    double t0 = st_begin();
     MPI_Bcast(buf, chk_int(bytes), MPI_BYTE, root, MPI_COMM_WORLD);
+    st_end(COMM_ST_BCAST, bytes, t0);
 }
 
 void comm_scatter(comm_ctx *c, const void *send, void *recv, size_t bytes,
                   int root) {
     (void)c;
+    double t0 = st_begin();
     MPI_Scatter((void *)send, chk_int(bytes), MPI_BYTE, recv, chk_int(bytes),
                 MPI_BYTE, root, MPI_COMM_WORLD);
+    st_end(COMM_ST_SCATTER, bytes, t0);
 }
 
 static int *to_int_array(const size_t *v, int n) {
@@ -67,12 +90,19 @@ void comm_scatterv(comm_ctx *c, const void *send, const size_t *counts,
                    const size_t *displs, void *recv, size_t recv_bytes,
                    int root) {
     int *ic = NULL, *id = NULL;
+    double t0 = st_begin();
+    size_t payload = 0;
     if (c->rank == root) {
         ic = to_int_array(counts, c->size);
         id = to_int_array(displs, c->size);
+        /* total payload on the root; other ranks record the call only,
+         * so the cross-rank SUM matches the local backend's accounting */
+        if (g_stats_on)
+            for (int i = 0; i < c->size; i++) payload += counts[i];
     }
     MPI_Scatterv((void *)send, ic, id, MPI_BYTE, recv, chk_int(recv_bytes),
                  MPI_BYTE, root, MPI_COMM_WORLD);
+    st_end(COMM_ST_SCATTERV, payload, t0);
     free(ic);
     free(id);
 }
@@ -80,28 +110,33 @@ void comm_scatterv(comm_ctx *c, const void *send, const size_t *counts,
 void comm_gather(comm_ctx *c, const void *send, void *recv, size_t bytes,
                  int root) {
     (void)c;
+    double t0 = st_begin();
     MPI_Gather((void *)send, chk_int(bytes), MPI_BYTE, recv, chk_int(bytes),
                MPI_BYTE, root, MPI_COMM_WORLD);
+    st_end(COMM_ST_GATHER, bytes, t0);
 }
 
 void comm_gatherv(comm_ctx *c, const void *send, size_t send_bytes,
                   void *recv, const size_t *counts, const size_t *displs,
                   int root) {
     int *ic = NULL, *id = NULL;
+    double t0 = st_begin();
     if (c->rank == root) {
         ic = to_int_array(counts, c->size);
         id = to_int_array(displs, c->size);
     }
     MPI_Gatherv((void *)send, chk_int(send_bytes), MPI_BYTE, recv, ic, id,
                 MPI_BYTE, root, MPI_COMM_WORLD);
+    st_end(COMM_ST_GATHERV, send_bytes, t0);
     free(ic);
     free(id);
 }
 
 void comm_allgather(comm_ctx *c, const void *send, void *recv, size_t bytes) {
-    (void)c;
+    double t0 = st_begin();
     MPI_Allgather((void *)send, chk_int(bytes), MPI_BYTE, recv,
                   chk_int(bytes), MPI_BYTE, MPI_COMM_WORLD);
+    st_end(COMM_ST_ALLGATHER, bytes * (size_t)c->size, t0);
 }
 
 static MPI_Datatype mpi_type(comm_type t) {
@@ -115,14 +150,18 @@ static MPI_Op mpi_op(comm_op op) {
 void comm_allreduce(comm_ctx *c, const void *send, void *recv, size_t count,
                     comm_type t, comm_op op) {
     (void)c;
+    double t0 = st_begin();
     MPI_Allreduce((void *)send, recv, chk_int(count), mpi_type(t), mpi_op(op),
                   MPI_COMM_WORLD);
+    st_end(COMM_ST_ALLREDUCE, count * ((t == COMM_T_U32) ? 4 : 8), t0);
 }
 
 void comm_exscan(comm_ctx *c, const void *send, void *recv, size_t count,
                  comm_type t, comm_op op) {
+    double t0 = st_begin();
     MPI_Exscan((void *)send, recv, chk_int(count), mpi_type(t), mpi_op(op),
                MPI_COMM_WORLD);
+    st_end(COMM_ST_EXSCAN, count * ((t == COMM_T_U32) ? 4 : 8), t0);
     if (c->rank == 0) {
         /* MPI leaves rank 0's Exscan result undefined; comm.h defines it
          * as the operator identity. */
@@ -132,19 +171,25 @@ void comm_exscan(comm_ctx *c, const void *send, void *recv, size_t count,
 }
 
 void comm_alltoall(comm_ctx *c, const void *send, void *recv, size_t bytes) {
-    (void)c;
+    double t0 = st_begin();
     MPI_Alltoall((void *)send, chk_int(bytes), MPI_BYTE, recv,
                  chk_int(bytes), MPI_BYTE, MPI_COMM_WORLD);
+    st_end(COMM_ST_ALLTOALL, bytes * (size_t)c->size, t0);
 }
 
 void comm_alltoallv(comm_ctx *c, const void *send, const size_t *scounts,
                     const size_t *sdispls, void *recv, const size_t *rcounts,
                     const size_t *rdispls) {
     int n = c->size;
+    double t0 = st_begin();
+    size_t sent = 0;
+    if (t0 >= 0.0)  /* O(P) byte sum only when telemetry is on */
+        for (int i = 0; i < n; i++) sent += scounts[i];
     int *isc = to_int_array(scounts, n), *isd = to_int_array(sdispls, n);
     int *irc = to_int_array(rcounts, n), *ird = to_int_array(rdispls, n);
     MPI_Alltoallv((void *)send, isc, isd, MPI_BYTE, recv, irc, ird, MPI_BYTE,
                   MPI_COMM_WORLD);
+    st_end(COMM_ST_ALLTOALLV, sent, t0);
     free(isc);
     free(isd);
     free(irc);
@@ -156,7 +201,36 @@ int comm_launch(void (*fn)(comm_ctx *, void *), void *arg) {
     comm_ctx ctx;
     MPI_Comm_rank(MPI_COMM_WORLD, &ctx.rank);
     MPI_Comm_size(MPI_COMM_WORLD, &ctx.size);
+    const char *stats_path = comm_stats_path();
+    g_stats_on = stats_path != NULL;
     fn(&ctx, arg);
+    if (g_stats_on) {
+        /* Reduce the per-rank tables to the comm_stats.h totals
+         * semantics — SUM calls/bytes, MAX seconds (as integer ns: the
+         * comm.h type census has no float reduction) — then rank 0
+         * appends the JSON line.  Raw MPI calls so the reduction never
+         * bills itself into the counters it is reducing. */
+        uint64_t cb[2 * COMM_ST_N], cb_tot[2 * COMM_ST_N];
+        uint64_t ns[COMM_ST_N], ns_max[COMM_ST_N];
+        for (int i = 0; i < COMM_ST_N; i++) {
+            cb[2 * i] = g_stats[i].calls;
+            cb[2 * i + 1] = g_stats[i].bytes;
+            ns[i] = (uint64_t)(g_stats[i].seconds * 1e9);
+        }
+        MPI_Allreduce(cb, cb_tot, 2 * COMM_ST_N, MPI_UINT64_T, MPI_SUM,
+                      MPI_COMM_WORLD);
+        MPI_Allreduce(ns, ns_max, COMM_ST_N, MPI_UINT64_T, MPI_MAX,
+                      MPI_COMM_WORLD);
+        if (ctx.rank == 0) {
+            comm_stat_t totals[COMM_ST_N];
+            for (int i = 0; i < COMM_ST_N; i++) {
+                totals[i].calls = cb_tot[2 * i];
+                totals[i].bytes = cb_tot[2 * i + 1];
+                totals[i].seconds = (double)ns_max[i] * 1e-9;
+            }
+            comm_stats_dump(stats_path, "mpi", ctx.size, totals);
+        }
+    }
     MPI_Finalize();
     return 0;
 }

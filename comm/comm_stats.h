@@ -1,0 +1,121 @@
+/* comm_stats.h — optional per-collective telemetry for the comm.h shim.
+ *
+ * The native twin of the TPU span layer (mpitest_tpu/utils/spans.py):
+ * when COMM_STATS=<path> is set, each backend counts every collective's
+ * calls / payload bytes / wall seconds per rank and appends ONE JSON
+ * line at the end of comm_launch (the shim's finalize point), so native
+ * and TPU runs feed `python -m mpitest_tpu.report` with the same
+ * per-collective schema:
+ *
+ *   {"v": "comm_stats.v1", "backend": "local"|"mpi", "ranks": P,
+ *    "collectives": {"alltoallv": {"calls": C, "bytes": B,
+ *                                  "seconds": S}, ...}}
+ *
+ * Aggregation semantics (documented in README/PARITY): calls and bytes
+ * are SUMS over ranks of each rank's per-call payload bytes (the buffer
+ * byte counts the caller passed — the same quantity the TPU spans
+ * record per collective); seconds is the MAX over ranks of that rank's
+ * accumulated wall time in the collective — critical-path time, so a
+ * P-rank barrier-bound run does not report P-fold inflated seconds.
+ *
+ * Header-only (static functions): both backends include it and stay
+ * single-translation-unit, so no Makefile in the tree needs a new
+ * object file.  Overhead when COMM_STATS is unset: one getenv at
+ * launch, one branch per collective.
+ */
+#ifndef COMM_STATS_H
+#define COMM_STATS_H
+
+/* clock_gettime under -std=c11 needs a POSIX feature macro; it only
+ * takes effect if no system header ran first, so backends include this
+ * header BEFORE comm.h (comm_local.c's _GNU_SOURCE subsumes it). */
+#if !defined(_GNU_SOURCE) && !defined(_POSIX_C_SOURCE)
+#define _POSIX_C_SOURCE 199309L
+#endif
+
+#include <stddef.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <time.h>
+
+enum {
+    COMM_ST_BARRIER,
+    COMM_ST_BCAST,
+    COMM_ST_SCATTER,
+    COMM_ST_SCATTERV,
+    COMM_ST_GATHER,
+    COMM_ST_GATHERV,
+    COMM_ST_ALLGATHER,
+    COMM_ST_ALLREDUCE,
+    COMM_ST_EXSCAN,
+    COMM_ST_ALLTOALL,
+    COMM_ST_ALLTOALLV,
+    COMM_ST_N
+};
+
+typedef struct {
+    unsigned long long calls;
+    unsigned long long bytes;
+    double seconds;
+} comm_stat_t;
+
+static const char *const comm_stat_names[COMM_ST_N] = {
+    "barrier",   "bcast",  "scatter",   "scatterv", "gather", "gatherv",
+    "allgather", "allreduce", "exscan", "alltoall", "alltoallv",
+};
+
+/* getenv once at launch; NULL means telemetry off (zero timer calls). */
+static inline const char *comm_stats_path(void) { return getenv("COMM_STATS"); }
+
+static inline double comm_stats_now(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (double)ts.tv_sec + 1e-9 * (double)ts.tv_nsec;
+}
+
+static inline void comm_stats_add(comm_stat_t *table, int which, size_t bytes,
+                           double seconds) {
+    table[which].calls += 1;
+    table[which].bytes += (unsigned long long)bytes;
+    table[which].seconds += seconds;
+}
+
+/* Fold rank tables into totals: sum calls/bytes, max seconds (see the
+ * aggregation semantics above). */
+static inline void comm_stats_fold(comm_stat_t *tot, const comm_stat_t *rank_tab) {
+    for (int i = 0; i < COMM_ST_N; i++) {
+        tot[i].calls += rank_tab[i].calls;
+        tot[i].bytes += rank_tab[i].bytes;
+        if (rank_tab[i].seconds > tot[i].seconds)
+            tot[i].seconds = rank_tab[i].seconds;
+    }
+}
+
+/* Append the one-line JSON record.  Returns 0 on success; on failure
+ * prints to stderr and returns nonzero — telemetry must never abort a
+ * completed sort. */
+static inline int comm_stats_dump(const char *path, const char *backend, int nranks,
+                           const comm_stat_t *totals) {
+    FILE *f = fopen(path, "a");
+    if (!f) {
+        fprintf(stderr, "comm_stats: cannot open %s for append\n", path);
+        return 1;
+    }
+    fprintf(f, "{\"v\": \"comm_stats.v1\", \"backend\": \"%s\", "
+               "\"ranks\": %d, \"collectives\": {", backend, nranks);
+    int first = 1;
+    for (int i = 0; i < COMM_ST_N; i++) {
+        if (!totals[i].calls)
+            continue;
+        fprintf(f, "%s\"%s\": {\"calls\": %llu, \"bytes\": %llu, "
+                   "\"seconds\": %.9f}",
+                first ? "" : ", ", comm_stat_names[i], totals[i].calls,
+                totals[i].bytes, totals[i].seconds);
+        first = 0;
+    }
+    fprintf(f, "}}\n");
+    fclose(f);
+    return 0;
+}
+
+#endif /* COMM_STATS_H */

@@ -28,8 +28,14 @@ splitter selection, default ``2P-1`` like the reference ``:90``).
 Observability (SURVEY.md §5 metrics row — additions the reference
 lacks, off by default so the byte-compatible contract is untouched):
 ``SORT_METRICS=<path>`` appends one JSON sidecar line per run (phase ms,
-Mkeys/s, exchange bytes + achieved GB/s); ``SORT_PROFILE=<logdir>``
-wraps the sort in a ``jax.profiler`` trace for TensorBoard.
+Mkeys/s, exchange bytes + achieved GB/s); ``SORT_TRACE=<path>`` streams
+the structured span log (nested phases, jit compile-vs-execute split,
+one span per radix pass / splitter round / collective with byte counts
+— ``mpitest_tpu/utils/spans.py``) as JSONL, aggregated by ``python -m
+mpitest_tpu.report`` alongside the native backends' ``COMM_STATS``
+records; ``SORT_TRACE_CHROME=<path>`` writes the same run as Chrome
+trace-event JSON (opens in Perfetto); ``SORT_PROFILE=<logdir>`` wraps
+the sort in a ``jax.profiler`` trace for TensorBoard.
 """
 
 from __future__ import annotations
@@ -113,22 +119,26 @@ def main(argv: list[str] | None = None) -> int:
             return 1
     import math
 
+    cf_env = os.environ.get("SORT_CAP_FACTOR", "2.0")
     try:
-        cap_factor = float(os.environ.get("SORT_CAP_FACTOR", "2.0"))
+        cap_factor = float(cf_env)
     except ValueError:
         cap_factor = 0.0
-    ov_env = os.environ.get("SORT_OVERSAMPLE")
-    try:
-        oversample = int(ov_env) if ov_env else None
-    except ValueError:
-        oversample = 0
     # isfinite: 'nan' passes a <= 0 gate (NaN compares False) and 'inf'
     # overflows the downstream int() — both are garbage, same contract.
-    if (not math.isfinite(cap_factor) or cap_factor <= 0
-            or (oversample is not None and oversample < 1)):
-        knob_error("SORT_CAP_FACTOR must be a finite number > 0 and "
-                   "SORT_OVERSAMPLE an integer >= 1")
+    if not math.isfinite(cap_factor) or cap_factor <= 0:
+        knob_error(f"SORT_CAP_FACTOR={cf_env!r}: use a finite number > 0")
         return 1
+    ov_env = os.environ.get("SORT_OVERSAMPLE")
+    oversample = None
+    if ov_env:
+        try:
+            oversample = int(ov_env)
+        except ValueError:
+            oversample = 0
+        if oversample < 1:
+            knob_error(f"SORT_OVERSAMPLE={ov_env!r}: use an integer >= 1")
+            return 1
 
     try:
         keys = read_keys_text(path, dtype=dtype)
@@ -166,6 +176,15 @@ def main(argv: list[str] | None = None) -> int:
         )
         out = res.to_numpy()  # materialize = the reference's final Gatherv
     end = time.perf_counter()
+
+    chrome_path = os.environ.get("SORT_TRACE_CHROME")
+    if chrome_path:
+        # Perfetto / chrome://tracing export of the same span log the
+        # SORT_TRACE JSONL streams (utils/spans.py).
+        import json
+
+        with open(chrome_path, "w") as f:
+            json.dump(tracer.spans.to_chrome_trace(), f)
 
     metrics_path = os.environ.get("SORT_METRICS")
     if metrics_path:

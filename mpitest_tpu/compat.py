@@ -1,0 +1,69 @@
+"""Version-compat shims for the JAX APIs this repo uses.
+
+The framework is developed against current JAX (``jax.shard_map``,
+``pallas.tpu.CompilerParams``); some images pin older releases where the
+same features live under pre-stabilization names (``jax.experimental.
+shard_map.shard_map`` with ``check_rep``, ``TPUCompilerParams``).  One
+shim module keeps every call site written against the CURRENT spelling
+and degrades to the old one only when the new is absent — so upgrading
+JAX never needs a code change here, and downgraded images still import.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:  # pre-stabilization spelling (jax < 0.5)
+    from jax.experimental.shard_map import shard_map as _old_shard_map
+
+    def shard_map(f=None, /, *, mesh, in_specs, out_specs, check_vma=True,
+                  **kw):
+        # old name for the varying-mesh-axes check: check_rep
+        return _old_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=check_vma, **kw)
+
+
+def tpu_compiler_params(**kw):
+    """``pltpu.CompilerParams`` (current) / ``TPUCompilerParams`` (old)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kw)
+
+
+def enable_x64(enabled: bool = True):
+    """``jax.enable_x64`` (current) / ``jax.experimental.enable_x64``
+    (old) — the scoped 64-bit-mode context manager."""
+    if hasattr(jax, "enable_x64"):
+        return jax.enable_x64(enabled)
+    from jax.experimental import enable_x64 as _old
+
+    return _old(enabled)
+
+
+def axis_size(name) -> int | None:
+    """Static size of a named mesh axis while tracing under shard_map —
+    ``jax.lax.axis_size`` where it exists, the axis-env frame otherwise.
+    Returns None outside any axis binding (telemetry then records only
+    the per-shard side of a collective's byte accounting)."""
+    try:
+        return int(jax.lax.axis_size(name))  # current spelling
+    except Exception:
+        pass
+    try:
+        frame = jax.core.axis_frame(name)    # old: frame object or int
+        return int(getattr(frame, "size", frame))
+    except Exception:
+        return None
+
+
+def shape_dtype_struct(shape, dtype, vma=()):
+    """``jax.ShapeDtypeStruct`` with varying-mesh-axes where supported;
+    old releases have no ``vma`` parameter (their shard_map tracks
+    replication via ``check_rep`` instead, see :func:`shard_map`)."""
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma))
+    except TypeError:
+        return jax.ShapeDtypeStruct(shape, dtype)

@@ -39,11 +39,35 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from mpitest_tpu import compat
 from mpitest_tpu.models import radix_sort, sample_sort
 from mpitest_tpu.ops import bitonic, kernels
 from mpitest_tpu.ops.keys import codec_for
 from mpitest_tpu.parallel.mesh import AXIS, key_sharding, make_mesh
 from mpitest_tpu.utils.trace import Tracer
+
+
+#: jit callables that have executed at least once — the compile-vs-
+#: execute split of the span layer: a callable's FIRST invocation pays
+#: tracing + XLA compile and is recorded as ``jit_compile_execute``;
+#: warm calls are ``jit_execute``.  Keyed by id(); the lru_caches above
+#: keep the callables alive, so collisions need an eviction first (and
+#: cost only a mislabeled span, never a wrong result).
+_warm_jits: set[int] = set()
+
+
+def _traced_call(tracer, label: str, fn, *args, **attrs):
+    """Call a jit program under a span that separates first-call (compile
+    included) from warm-call wall time — the split ISSUE/SURVEY §5 needs
+    to attribute 'slow run' to compile vs execute."""
+    first = id(fn) not in _warm_jits
+    name = "jit_compile_execute" if first else "jit_execute"
+    with tracer.spans.span(name, label=label, **attrs):
+        out = fn(*args)
+    if first:
+        _warm_jits.add(id(fn))
+        tracer.count("jit_first_calls", 1)
+    return out
 
 
 @dataclass
@@ -368,7 +392,8 @@ def _local_pair_sort(x, is_device, codec, dtype, mesh, tracer):
         # would cost a tunnel round-trip per decision.
         try:
             with tracer.phase("sort"):
-                hi_s, lo_s, code = _compile_pair_fused(dtype.name, impl)(x)
+                hi_s, lo_s, code = _traced_call(
+                    tracer, "pair_fused", _compile_pair_fused(dtype.name, impl), x)
                 code = int(code)
         except jax.errors.JaxRuntimeError as e:
             if not _is_f64_lowering_gap(e, dtype, codec, _device_platform(x)):
@@ -402,16 +427,19 @@ def _local_pair_sort(x, is_device, codec, dtype, mesh, tracer):
             # the constant word never moves; 1-word engine on the other
             tracer.counters["local_engine"] = f"bitonic_1w{sort_w}"
             with tracer.phase("sort"):
-                s_out = _compile_local(1, engine)(words[sort_w])[0]
+                s_out = _traced_call(
+                    tracer, "local_1w", _compile_local(1, engine), words[sort_w])[0]
             return (words[0], s_out) if sort_w == 1 else (s_out, words[1])
     if dup:
         tracer.counters["local_engine"] = "lax"
         tracer.count("pair_dup_reroute", 1)
         with tracer.phase("sort"):
-            return _compile_local(2, "lax")(*words)
+            return _traced_call(tracer, "local_2w_lax",
+                                _compile_local(2, "lax"), *words)
     tracer.counters["local_engine"] = "bitonic_pair"
     with tracer.phase("sort"):
-        hi_s, lo_s, bad = _compile_pair_sort(impl)(*words)
+        hi_s, lo_s, bad = _traced_call(tracer, "pair_sort",
+                                       _compile_pair_sort(impl), *words)
         bad = bool(bad)
     if bad:
         tracer.verbose(
@@ -419,7 +447,8 @@ def _local_pair_sort(x, is_device, codec, dtype, mesh, tracer):
             "missed); falling back to lax.sort")
         tracer.count("pair_residual_fallback", 1)
         with tracer.phase("sort"):
-            return _compile_local(2, "lax")(*words)
+            return _traced_call(tracer, "local_2w_lax",
+                                _compile_local(2, "lax"), *words)
     return (hi_s, lo_s)
 
 
@@ -532,7 +561,7 @@ def _compile_radix(mesh: Mesh, n_words: int, n: int, digit_bits: int, cap: int,
         return out, max_cnt
 
     return jax.jit(
-        jax.shard_map(
+        compat.shard_map(
             f,
             mesh=mesh,
             in_specs=(P(AXIS),) * n_words,
@@ -556,7 +585,7 @@ def _compile_sample(mesh: Mesh, n_words: int, n: int, cap: int, oversample: int,
         return out, count[None], max_cnt
 
     return jax.jit(
-        jax.shard_map(
+        compat.shard_map(
             f,
             mesh=mesh,
             in_specs=(P(AXIS),) * n_words,
@@ -734,6 +763,23 @@ def radix_pass_states(x, mesh: Mesh | None = None, digit_bits: int | None = None
         yield k, n, full
 
 
+def _device_mem_high_water(span, mesh: Mesh | None) -> None:
+    """Attach the mesh devices' peak-HBM high-water to ``span`` where the
+    backend exposes ``memory_stats()`` (real TPU; CPU returns nothing).
+    Best-effort telemetry — never raises."""
+    try:
+        devs = list(mesh.devices.flat) if mesh is not None else jax.devices()
+        peak = 0
+        for d in devs:
+            stats = d.memory_stats() if hasattr(d, "memory_stats") else None
+            if stats:
+                peak = max(peak, int(stats.get("peak_bytes_in_use", 0)))
+        if peak:
+            span.attrs["device_mem_peak_bytes"] = peak
+    except Exception:
+        pass
+
+
 def sort(
     x,
     algorithm: str = "radix",
@@ -747,6 +793,47 @@ def sort(
 ):
     """Sort integer keys on the mesh; returns a sorted numpy array
     (or the device-resident :class:`DistributedSortResult`).
+
+    Telemetry: the run accumulates a structured span log on
+    ``tracer.spans`` (:mod:`mpitest_tpu.utils.spans`) — nested phases,
+    jit compile-vs-execute splits, one trace-time span per radix pass /
+    splitter round / collective with byte counts, and the device memory
+    high-water where ``memory_stats()`` exists.  ``SORT_TRACE=<path>``
+    streams it as JSONL; ``tracer.spans.to_chrome_trace()`` exports the
+    same run for Perfetto.  See the module docstring of utils/spans.py
+    for the device-side granularity contract.
+    """
+    if algorithm not in ("radix", "sample"):
+        raise ValueError(f"unknown algorithm {algorithm!r}")
+    tracer = tracer or Tracer()
+    trace_path = os.environ.get("SORT_TRACE")
+    if trace_path and tracer.spans.stream_path is None:
+        tracer.spans.stream_path = trace_path
+    size = getattr(x, "size", None)
+    with tracer.spans.span(
+        "sort", algorithm=algorithm,
+        n=int(size) if size is not None else None,
+        dtype=str(getattr(x, "dtype", "")) or None,
+    ) as sp:
+        out = _sort_impl(x, algorithm, mesh, digit_bits, cap_factor,
+                         oversample, tracer, return_result, pack)
+        _device_mem_high_water(sp, mesh)
+    return out
+
+
+def _sort_impl(
+    x,
+    algorithm: str,
+    mesh: Mesh | None,
+    digit_bits: int | None,
+    cap_factor: float,
+    oversample: int | None,
+    tracer: Tracer,
+    return_result: bool,
+    pack: str | None,
+):
+    """The sort() body (see the public wrapper's docstring — this layer
+    assumes a validated algorithm and a live tracer/span log).
 
     ``algorithm``: ``"radix"`` (flagship: perfectly load-balanced, fixed
     pass count) or ``"sample"`` (one exchange round; cap-sensitive under
@@ -777,9 +864,6 @@ def sort(
     respect to the bits actually resident on the device; host-input
     float64 is bit-exact, full stop.
     """
-    if algorithm not in ("radix", "sample"):
-        raise ValueError(f"unknown algorithm {algorithm!r}")
-    tracer = tracer or Tracer()
     is_device = isinstance(x, jax.Array)
     if not is_device:
         x = np.asarray(x)
@@ -817,7 +901,9 @@ def sort(
         if is_device:
             try:
                 with tracer.phase("sort"):
-                    out = _compile_local_device(dtype.name, _local_engine())(
+                    out = _traced_call(
+                        tracer, "local_device",
+                        _compile_local_device(dtype.name, _local_engine()),
                         x.reshape(-1))
             except jax.errors.JaxRuntimeError as e:
                 # float64 device-side encode needs a f64->u32 bitcast some
@@ -837,7 +923,9 @@ def sort(
                     jax.device_put(w, mesh.devices.flat[0]) for w in words_np
                 )
             with tracer.phase("sort"):
-                out = _compile_local(codec.n_words, _local_engine())(*words)
+                out = _traced_call(tracer, "local",
+                                   _compile_local(codec.n_words,
+                                                  _local_engine()), *words)
         res = DistributedSortResult(out, N, dtype)
         if return_result:
             return res
@@ -857,11 +945,16 @@ def sort(
                     # otherwise conflict with the jit's mesh-wide
                     # out_shardings.
                     x_flat = jax.device_put(x_flat, key_sharding(mesh))
-                    words = _compile_encode_pad(dtype.name, N, mesh)(x_flat)
+                    words = _traced_call(
+                        tracer, "encode_pad",
+                        _compile_encode_pad(dtype.name, N, mesh), x_flat)
                 else:
                     # Uneven N cannot be mesh-sharded directly; encode+pad
                     # wherever the input lives, then land the even result.
-                    ws = _compile_encode_pad(dtype.name, n_ranks * n, None)(x_flat)
+                    ws = _traced_call(
+                        tracer, "encode_pad",
+                        _compile_encode_pad(dtype.name, n_ranks * n, None),
+                        x_flat)
                     words = tuple(jax.device_put(w, key_sharding(mesh))
                                   for w in ws)
         except jax.errors.JaxRuntimeError as e:
@@ -930,7 +1023,9 @@ def sort(
                 fn = _compile_sample(mesh, codec.n_words, n, cap, oversample,
                                      pack_impl, spmd_engine)
                 with tracer.phase("sort"):
-                    out, counts, max_cnt = fn(*words)
+                    out, counts, max_cnt = _traced_call(
+                        tracer, "sample_spmd", fn, *words,
+                        n=n, cap=cap, ranks=n_ranks)
                     max_cnt = int(max_cnt)
                 tracer.count(
                     "exchange_bytes",
@@ -977,7 +1072,10 @@ def sort(
             fn = _compile_radix(mesh, codec.n_words, n, digit_bits, cap, passes,
                                 pack_impl)
             with tracer.phase("sort"):
-                out, max_cnt = fn(*words)
+                out, max_cnt = _traced_call(
+                    tracer, "radix_spmd", fn, *words,
+                    n=n, cap=cap, passes=passes, digit_bits=digit_bits,
+                    ranks=n_ranks)
                 max_cnt = int(max_cnt)
             # Exchange accounting (SURVEY.md §5 metrics row), counted per
             # attempt so discarded overflow retries — whose all_to_all

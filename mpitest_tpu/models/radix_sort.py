@@ -56,8 +56,21 @@ from jax import lax
 from mpitest_tpu.ops import kernels
 from mpitest_tpu.parallel import collectives as coll
 from mpitest_tpu.parallel.mesh import AXIS
+from mpitest_tpu.utils import spans
 
 Words = tuple[jax.Array, ...]
+
+
+def _pass_span(k: int, w_idx: int, shift: int, digit_bits: int, n: int,
+               cap: int):
+    """Trace-time span for one radix pass (utils/spans.py granularity
+    contract): the collectives traced inside the pass body nest under
+    it, so the SORT_TRACE stream shows pass → {all_gather, exchange}
+    structure.  ``trace_time`` marks the dt as host tracing wall, not
+    device execution (the fused program is one dispatch)."""
+    return spans.maybe_span("radix_pass", pass_index=k, word=w_idx,
+                            shift=shift, digit_bits=digit_bits, n=n,
+                            cap=cap, trace_time=True)
 
 
 def _lane_slots(recv_cnt: jax.Array, H: jax.Array, digit_base: jax.Array,
@@ -159,46 +172,47 @@ def radix_sort_spmd(
     recv_cnt = None
     prev = None  # (H, digit_base, rank_base) of the pending exchange
 
-    for w_idx, shift in plan:
-        if recv is None:
-            # First pass: the flat shard is trivially "merged"; one
-            # stable 1-key sort groups by digit (stability = position
-            # order, exactly the (digit, slot) key of later passes).
-            d = kernels.digit_at(words[w_idx], shift, digit_bits)
-            ops = lax.sort([d] + list(words), num_keys=1, is_stable=True)
-            sd, sorted_words = ops[0], tuple(ops[1:])
-        else:
-            # Fused pass: merge the pending exchange buffer AND group by
-            # the new digit with ONE sort keyed on (digit, slot) — the
-            # pair is unique per valid lane, so no stability needed.
-            slot = _lane_slots(recv_cnt, *prev, n, cap, axis)
-            d = kernels.digit_at(recv[w_idx], shift, digit_bits)
-            c = lax.iota(jnp.int32, cap)[None, :]
-            d = jnp.where(c < recv_cnt[:, None], d, n_bins)
-            ops = lax.sort(
-                [d.reshape(-1), slot.reshape(-1)] + [r.reshape(-1) for r in recv],
-                num_keys=2, is_stable=False,
+    for k, (w_idx, shift) in enumerate(plan):
+        with _pass_span(k + 1, w_idx, shift, digit_bits, n, cap):
+            if recv is None:
+                # First pass: the flat shard is trivially "merged"; one
+                # stable 1-key sort groups by digit (stability = position
+                # order, exactly the (digit, slot) key of later passes).
+                d = kernels.digit_at(words[w_idx], shift, digit_bits)
+                ops = lax.sort([d] + list(words), num_keys=1, is_stable=True)
+                sd, sorted_words = ops[0], tuple(ops[1:])
+            else:
+                # Fused pass: merge the pending exchange buffer AND group by
+                # the new digit with ONE sort keyed on (digit, slot) — the
+                # pair is unique per valid lane, so no stability needed.
+                slot = _lane_slots(recv_cnt, *prev, n, cap, axis)
+                d = kernels.digit_at(recv[w_idx], shift, digit_bits)
+                c = lax.iota(jnp.int32, cap)[None, :]
+                d = jnp.where(c < recv_cnt[:, None], d, n_bins)
+                ops = lax.sort(
+                    [d.reshape(-1), slot.reshape(-1)] + [r.reshape(-1) for r in recv],
+                    num_keys=2, is_stable=False,
+                )
+                # Valid lanes total exactly n and sort to the front (invalid
+                # carry the n_bins sentinel digit).
+                sd = ops[0][:n]
+                sorted_words = tuple(o[:n] for o in ops[2:])
+
+            # Histogram + first-occurrence offsets from the sorted digits.
+            h, lo_local = kernels.histogram_sorted(sd, n_bins)
+            H, tot, rank_base = coll.exscan_counts(h, axis)
+            digit_base = coll.exclusive_cumsum(tot)
+            base = digit_base + rank_base[my]      # [bins] my global run starts
+
+            # dest[j] = base[sd[j]] + (j - lo[sd[j]]) — gather-free step fn.
+            dest = kernels.piecewise_fill(lo_local, base - lo_local, n) + lax.iota(jnp.int32, n)
+            send_start, send_cnt = _send_segments(dest, n, n_ranks)
+
+            recv, recv_cnt, mc = coll.ragged_all_to_all(
+                sorted_words, send_start, send_cnt, cap, n_ranks, axis, pack=pack
             )
-            # Valid lanes total exactly n and sort to the front (invalid
-            # carry the n_bins sentinel digit).
-            sd = ops[0][:n]
-            sorted_words = tuple(o[:n] for o in ops[2:])
-
-        # Histogram + first-occurrence offsets from the sorted digits.
-        h, lo_local = kernels.histogram_sorted(sd, n_bins)
-        H, tot, rank_base = coll.exscan_counts(h, axis)
-        digit_base = coll.exclusive_cumsum(tot)
-        base = digit_base + rank_base[my]          # [bins] my global run starts
-
-        # dest[j] = base[sd[j]] + (j - lo[sd[j]]) — gather-free step fn.
-        dest = kernels.piecewise_fill(lo_local, base - lo_local, n) + lax.iota(jnp.int32, n)
-        send_start, send_cnt = _send_segments(dest, n, n_ranks)
-
-        recv, recv_cnt, mc = coll.ragged_all_to_all(
-            sorted_words, send_start, send_cnt, cap, n_ranks, axis, pack=pack
-        )
-        max_cnt = jnp.maximum(max_cnt, mc)
-        prev = (H, digit_base, rank_base)
+            max_cnt = jnp.maximum(max_cnt, mc)
+            prev = (H, digit_base, rank_base)
 
     # Materialize the last pass's pending merge: one 1-key sort on slot.
     slot = _lane_slots(recv_cnt, *prev, n, cap, axis)

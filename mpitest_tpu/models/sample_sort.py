@@ -39,6 +39,7 @@ from jax import lax
 from mpitest_tpu.ops import kernels, keys
 from mpitest_tpu.parallel import collectives as coll
 from mpitest_tpu.parallel.mesh import AXIS
+from mpitest_tpu.utils import spans
 
 Words = tuple[jax.Array, ...]
 
@@ -50,12 +51,18 @@ def select_splitters(sorted_words: Words, n_ranks: int, oversample: int,
     ``oversample`` is the per-rank sample count (the reference uses 2P-1,
     ``mpi_sample_sort.c:89``); larger values tighten splitter balance at
     negligible cost (P·oversample words total)."""
-    samples = kernels.evenly_spaced_samples(sorted_words, oversample)
-    gathered = tuple(coll.all_gather(s, axis).reshape(-1) for s in samples)  # [P*s]
-    gsorted = kernels.local_sort(gathered)
-    m = n_ranks * oversample
-    idx = (jnp.arange(1, n_ranks, dtype=jnp.int32) * m) // n_ranks           # P-1 picks
-    return tuple(w[idx] for w in gsorted)
+    # Trace-time span (utils/spans.py): the sample all_gather nests
+    # under the splitter round in the SORT_TRACE stream.
+    with spans.maybe_span("splitter_round", ranks=n_ranks,
+                          oversample=oversample, trace_time=True,
+                          sample_bytes=(n_ranks * oversample * 4
+                                        * len(sorted_words))):
+        samples = kernels.evenly_spaced_samples(sorted_words, oversample)
+        gathered = tuple(coll.all_gather(s, axis).reshape(-1) for s in samples)  # [P*s]
+        gsorted = kernels.local_sort(gathered)
+        m = n_ranks * oversample
+        idx = (jnp.arange(1, n_ranks, dtype=jnp.int32) * m) // n_ranks       # P-1 picks
+        return tuple(w[idx] for w in gsorted)
 
 
 def sample_sort_spmd(

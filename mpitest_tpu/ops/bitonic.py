@@ -79,6 +79,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from mpitest_tpu import compat
+
 LANES = 128
 LANES_LOG2 = 7
 #: log2 of elements per block: S = 2^(B-7) sublanes x 128 lanes = 256 KiB
@@ -105,7 +107,7 @@ _CROSS_GROUP = 8
 #: the B=17 block experiment) while leaving ample room for the
 #: pipeline's double buffers.
 _VMEM_LIMIT = 48 * 1024 * 1024
-_COMPILER_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT)
+_COMPILER_PARAMS = compat.tpu_compiler_params(vmem_limit_bytes=_VMEM_LIMIT)
 
 #: Index-map constants pinned to int32: under jax_enable_x64 (the
 #: device-resident 64-bit path) Python-int literals in index maps

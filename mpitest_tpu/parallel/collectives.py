@@ -23,9 +23,29 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from mpitest_tpu import compat
 from mpitest_tpu.parallel.mesh import AXIS
+from mpitest_tpu.utils import spans
 
 Words = tuple[jax.Array, ...]
+
+
+def _emit_collective(name: str, x, axis: str, **attrs) -> None:
+    """Trace-time telemetry: one point event per collective per compile,
+    with the static byte accounting (see utils/spans.py on why device
+    collectives are trace-time events, not host-timed spans).  ``bytes``
+    is the per-rank payload entering the collective; ``bytes_out`` the
+    per-rank result size where the axis size is statically known."""
+    log = spans.current_log()
+    if log is None:
+        return
+    b_in = int(x.size) * x.dtype.itemsize
+    P_ = compat.axis_size(axis)
+    if P_ is not None:
+        attrs.setdefault("ranks", P_)
+        if name == "all_gather":
+            attrs.setdefault("bytes_out", b_in * P_)
+    log.event(name, bytes=b_in, axis=axis, **attrs)
 
 
 def rank(axis: str = AXIS) -> jax.Array:
@@ -36,15 +56,18 @@ def rank(axis: str = AXIS) -> jax.Array:
 def all_gather(x: jax.Array, axis: str = AXIS) -> jax.Array:
     """``MPI_Allgather`` (and the gather-to-root patterns): every shard gets
     [P, ...] — strictly more than MPI's rooted Gather gives, for free."""
+    _emit_collective("all_gather", x, axis)
     return lax.all_gather(x, axis)
 
 
 def psum(x: jax.Array, axis: str = AXIS) -> jax.Array:
     """``MPI_Allreduce(SUM)``."""
+    _emit_collective("psum", x, axis, op="sum")
     return lax.psum(x, axis)
 
 
 def pmax(x: jax.Array, axis: str = AXIS) -> jax.Array:
+    _emit_collective("pmax", x, axis, op="max")
     return lax.pmax(x, axis)
 
 
@@ -102,6 +125,20 @@ def ragged_all_to_all(
     from mpitest_tpu.ops import kernels
 
     n = arrays[0].shape[0]
+    log = spans.current_log()
+    if log is not None:
+        # Static byte accounting of the padded exchange (trace-time; see
+        # utils/spans.py): each array ships a [P, cap] block matrix of
+        # which the self-block never crosses a link, plus the explicit
+        # int32[P] count exchange that replaces the tag-as-length trick.
+        itemsize = sum(a.dtype.itemsize for a in arrays)
+        log.event(
+            "ragged_all_to_all",
+            bytes=n_ranks * cap * itemsize + n_ranks * 4,
+            wire_bytes=(n_ranks - 1) * cap * itemsize + (n_ranks - 1) * 4,
+            ranks=n_ranks, cap=cap, n=n, arrays=len(arrays), pack=pack,
+            axis=axis,
+        )
     if pack == "xla":
         j = lax.iota(jnp.int32, n)
         # Destination rank and segment start per element, gather-free: two

@@ -1,0 +1,359 @@
+"""Telemetry aggregation + regression CLI: ``python -m mpitest_tpu.report``.
+
+The consumer end of the unified telemetry layer (SURVEY.md §5 metrics
+row): every producer in the repo emits JSONL with a self-identifying
+shape, and this module reads them all —
+
+* ``SORT_TRACE`` span streams (``{"v": "span.v1", ...}`` —
+  utils/spans.py),
+* ``COMM_STATS`` native backend records (``{"v": "comm_stats.v1", ...}``
+  — comm/comm_stats.h),
+* ``SORT_METRICS`` sidecars (``{"ts", "config", "metrics"}`` —
+  utils/metrics.py),
+* bench driver rows (``{"metric", "value", ...}`` — bench.py stdout and
+  ``bench/BASELINE_RESULTS.jsonl``)
+
+— and renders one per-phase / per-collective table in which native
+(MPI/pthreads) and TPU runs line up on the comm.h collective vocabulary
+(:data:`mpitest_tpu.utils.spans.MPI_EQUIV`): the per-pass, per-collective
+evidence the MPI-vs-ICI north star needs.
+
+Modes:
+
+* default — aggregate the given files (``bench/BASELINE_RESULTS.jsonl``
+  when none given) and print the tables.
+* ``--baseline FILE`` — flag metric regressions against pinned rows.  A
+  baseline row carrying a ``"host"`` provenance fingerprint is only
+  compared when it matches this machine (``utils/platform.py
+  host_fingerprint``) — cross-host ratios are weather, not regressions
+  (ADVICE round 5).  Exit code 2 when any regression is flagged.
+* ``--check`` — schema-validate the files (the ``make
+  telemetry-selftest`` gate): span streams must parse, nest, and export
+  to Chrome trace-event; comm_stats lines must carry
+  calls/bytes/seconds per collective.  Exit 1 on any violation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from mpitest_tpu.utils.spans import MPI_EQUIV, SCHEMA as SPAN_SCHEMA
+
+COMM_STATS_SCHEMA = "comm_stats.v1"
+
+
+# --------------------------------------------------------------- loading
+
+def load_rows(path: str) -> list[dict]:
+    """All JSON objects in a JSONL file, each tagged with its source
+    ``kind``: span | comm_stats | metrics | bench | unknown."""
+    rows = []
+    for lineno, line in enumerate(Path(path).read_text().splitlines(), 1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError as e:
+            rows.append({"kind": "invalid", "path": path, "line": lineno,
+                         "error": f"not valid JSON ({e})"})
+            continue
+        if not isinstance(obj, dict):
+            # valid JSON, wrong shape (e.g. a bare list/number) — a
+            # schema violation to report, never a crash in the checker
+            rows.append({"kind": "invalid", "path": path, "line": lineno,
+                         "error": "top-level value is not an object"})
+            continue
+        obj["_path"], obj["_line"] = path, lineno
+        v = obj.get("v")
+        if v == SPAN_SCHEMA:
+            obj["kind"] = "span"
+        elif v == COMM_STATS_SCHEMA:
+            obj["kind"] = "comm_stats"
+        elif "metrics" in obj and "config" in obj:
+            obj["kind"] = "metrics"
+        elif "metric" in obj and "value" in obj:
+            obj["kind"] = "bench"
+        else:
+            obj["kind"] = "unknown"
+        rows.append(obj)
+    return rows
+
+
+# ----------------------------------------------------------- aggregation
+
+def aggregate(rows: list[dict]) -> dict:
+    """Fold rows into the report tables.
+
+    Returns ``{"phases": {name: {"ms", "count"}},
+               "collectives": {source: {coll: {calls, bytes, seconds}}},
+               "metrics": {metric: latest bench/metrics value row},
+               "spans": {name: count}}``.
+    Collective sources are ``tpu`` (span events, mapped through
+    MPI_EQUIV) and ``native/<backend>x<ranks>`` (comm_stats records).
+    """
+    phases: dict[str, dict] = {}
+    colls: dict[str, dict] = {}
+    metrics: dict[str, dict] = {}
+    span_counts: dict[str, int] = {}
+
+    def add_coll(source: str, name: str, calls, nbytes, seconds) -> None:
+        row = colls.setdefault(source, {}).setdefault(
+            name, {"calls": 0, "bytes": 0, "seconds": 0.0})
+        row["calls"] += int(calls)
+        row["bytes"] += int(nbytes)
+        row["seconds"] += float(seconds)
+
+    for obj in rows:
+        kind = obj.get("kind")
+        if kind == "span":
+            name = obj.get("name", "?")
+            span_counts[name] = span_counts.get(name, 0) + 1
+            if name.startswith("phase:"):
+                p = phases.setdefault(name[len("phase:"):],
+                                      {"ms": 0.0, "count": 0})
+                p["ms"] += float(obj.get("dt", 0.0)) * 1e3
+                p["count"] += 1
+            elif name in MPI_EQUIV:
+                add_coll("tpu", MPI_EQUIV[name], 1,
+                         obj.get("attrs", {}).get("bytes", 0),
+                         obj.get("dt", 0.0))
+        elif kind == "comm_stats":
+            source = f"native/{obj.get('backend', '?')}x{obj.get('ranks', '?')}"
+            for cname, c in obj.get("collectives", {}).items():
+                add_coll(source, cname, c.get("calls", 0),
+                         c.get("bytes", 0), c.get("seconds", 0.0))
+        elif kind == "metrics":
+            for mname, m in obj.get("metrics", {}).items():
+                if mname.startswith("phase_") and mname.endswith("_ms"):
+                    p = phases.setdefault(mname[len("phase_"):-len("_ms")],
+                                          {"ms": 0.0, "count": 0})
+                    p["ms"] += float(m.get("value", 0.0))
+                    p["count"] += 1
+                else:
+                    metrics[mname] = {"value": m.get("value"),
+                                      "unit": m.get("unit"),
+                                      "config": obj.get("config")}
+        elif kind == "bench":
+            metrics[obj["metric"]] = {k: v for k, v in obj.items()
+                                      if not k.startswith("_")}
+    return {"phases": phases, "collectives": colls, "metrics": metrics,
+            "spans": span_counts}
+
+
+# ------------------------------------------------------------ regression
+
+def flag_regressions(current: dict, baseline_rows: list[dict],
+                     threshold: float, host: str) -> list[dict]:
+    """Compare the aggregated ``current["metrics"]`` against pinned
+    baseline bench rows.  Higher is better (every repo metric is a
+    throughput/ratio); a current value below ``threshold * pinned`` is a
+    regression.  A baseline row with a ``host`` fingerprint that does
+    not match this machine is reported as skipped, never compared."""
+    findings = []
+    for row in baseline_rows:
+        if row.get("kind", "bench") != "bench":
+            continue
+        name = row["metric"]
+        pinned = float(row["value"])
+        row_host = row.get("host")
+        if row_host and row_host != host:
+            findings.append({"metric": name, "status": "skipped",
+                             "reason": f"host mismatch (pinned on "
+                                       f"{row_host!r})"})
+            continue
+        cur = current["metrics"].get(name)
+        if cur is None or cur.get("value") is None:
+            findings.append({"metric": name, "status": "missing",
+                             "reason": "no current row for pinned metric"})
+            continue
+        val = float(cur["value"])
+        if pinned > 0 and val < threshold * pinned:
+            findings.append({"metric": name, "status": "REGRESSION",
+                             "current": val, "pinned": pinned,
+                             "ratio": round(val / pinned, 3)})
+        else:
+            findings.append({"metric": name, "status": "ok",
+                             "current": val, "pinned": pinned,
+                             "ratio": round(val / pinned, 3)
+                             if pinned else None})
+    return findings
+
+
+# ----------------------------------------------------------------- check
+
+def check_rows(rows: list[dict]) -> list[str]:
+    """Schema violations in loaded rows (empty list = clean).  This is
+    the contract `make telemetry-selftest` enforces on both the
+    SORT_TRACE stream and the COMM_STATS record."""
+    errors = []
+    spans_by_id: dict[tuple, dict] = {}
+    for obj in rows:
+        where = f"{obj.get('_path', obj.get('path'))}:{obj.get('_line', obj.get('line'))}"
+        kind = obj.get("kind")
+        if kind == "invalid":
+            errors.append(f"{where}: {obj['error']}")
+        elif kind == "span":
+            for key in ("name", "id", "t0", "dt", "attrs"):
+                if key not in obj:
+                    errors.append(f"{where}: span missing {key!r}")
+            if "attrs" in obj and not isinstance(obj["attrs"], dict):
+                errors.append(f"{where}: span attrs must be an object")
+            if isinstance(obj.get("dt"), (int, float)) and obj["dt"] < 0:
+                errors.append(f"{where}: span dt < 0")
+            spans_by_id[(obj.get("_path"), obj.get("id"))] = obj
+        elif kind == "comm_stats":
+            if not isinstance(obj.get("ranks"), int) or obj["ranks"] < 1:
+                errors.append(f"{where}: comm_stats needs integer ranks >= 1")
+            cols = obj.get("collectives")
+            if not isinstance(cols, dict) or not cols:
+                errors.append(f"{where}: comm_stats needs a non-empty "
+                              "collectives object")
+                continue
+            for cname, c in cols.items():
+                if not isinstance(c, dict):
+                    errors.append(f"{where}: collective {cname!r} must be "
+                                  "an object")
+                    continue
+                for key in ("calls", "bytes", "seconds"):
+                    if key not in c:
+                        errors.append(f"{where}: collective {cname!r} "
+                                      f"missing {key!r}")
+        elif kind == "unknown":
+            errors.append(f"{where}: unrecognized record shape")
+    # span parent links must resolve within the same stream
+    for (path, _), obj in spans_by_id.items():
+        parent = obj.get("parent")
+        if parent is not None and (path, parent) not in spans_by_id:
+            errors.append(f"{path}: span id={obj.get('id')} has dangling "
+                          f"parent {parent}")
+    return errors
+
+
+# ---------------------------------------------------------------- tables
+
+def _fmt_bytes(b: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if b < 1024 or unit == "GiB":
+            return f"{b:.1f}{unit}" if unit != "B" else f"{int(b)}B"
+        b /= 1024
+    return f"{b:.1f}GiB"
+
+
+def render(agg: dict) -> str:
+    out = []
+    if agg["phases"]:
+        out.append("per-phase wall time")
+        out.append(f"  {'phase':<16} {'ms':>12} {'count':>7}")
+        for name, p in sorted(agg["phases"].items(),
+                              key=lambda kv: -kv[1]["ms"]):
+            out.append(f"  {name:<16} {p['ms']:>12.3f} {p['count']:>7}")
+    if agg["collectives"]:
+        out.append("")
+        out.append("per-collective traffic (comm.h vocabulary)")
+        out.append(f"  {'source':<18} {'collective':<12} {'calls':>7} "
+                   f"{'bytes':>12} {'seconds':>11}")
+        for source in sorted(agg["collectives"]):
+            for cname, c in sorted(agg["collectives"][source].items()):
+                out.append(
+                    f"  {source:<18} {cname:<12} {c['calls']:>7} "
+                    f"{_fmt_bytes(c['bytes']):>12} {c['seconds']:>11.6f}")
+    if agg["metrics"]:
+        out.append("")
+        out.append("metrics (latest row per name)")
+        for name, m in sorted(agg["metrics"].items()):
+            unit = m.get("unit") or ""
+            out.append(f"  {name:<40} {m.get('value')} {unit}")
+    if agg["spans"]:
+        out.append("")
+        out.append("span census: " + ", ".join(
+            f"{n}={c}" for n, c in sorted(agg["spans"].items())))
+    return "\n".join(out) if out else "(no telemetry rows)"
+
+
+# ------------------------------------------------------------------ main
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m mpitest_tpu.report",
+        description="Aggregate mpitest_tpu telemetry JSONL (SORT_TRACE "
+                    "spans, COMM_STATS, SORT_METRICS, bench rows); flag "
+                    "regressions against a pinned baseline.")
+    ap.add_argument("files", nargs="*",
+                    help="JSONL files (default: bench/BASELINE_RESULTS.jsonl"
+                         " when present)")
+    ap.add_argument("--check", action="store_true",
+                    help="schema-validate the files; exit 1 on violations")
+    ap.add_argument("--baseline",
+                    help="pinned baseline JSONL of bench rows; regressions "
+                         "exit 2")
+    ap.add_argument("--threshold", type=float, default=0.9,
+                    help="regression threshold: flag when current < "
+                         "THRESHOLD * pinned (default 0.9)")
+    args = ap.parse_args(argv)
+
+    files = list(args.files)
+    if not files:
+        default = Path("bench/BASELINE_RESULTS.jsonl")
+        if default.exists():
+            files = [str(default)]
+        else:
+            ap.error("no files given and bench/BASELINE_RESULTS.jsonl "
+                     "not found")
+    rows: list[dict] = []
+    for f in files:
+        try:
+            rows.extend(load_rows(f))
+        except OSError as e:
+            print(f"[ERROR] {f}: {e}", file=sys.stderr)
+            return 1
+
+    if args.check:
+        errors = check_rows(rows)
+        n_spans = sum(1 for r in rows if r.get("kind") == "span")
+        n_stats = sum(1 for r in rows if r.get("kind") == "comm_stats")
+        if errors:
+            for e in errors:
+                print(f"[ERROR] {e}", file=sys.stderr)
+            return 1
+        print(f"telemetry check OK: {len(rows)} rows "
+              f"({n_spans} spans, {n_stats} comm_stats) across "
+              f"{len(files)} file(s)")
+        return 0
+
+    agg = aggregate(rows)
+    print(render(agg))
+
+    if args.baseline:
+        from mpitest_tpu.utils.platform import host_fingerprint
+
+        try:
+            baseline_rows = load_rows(args.baseline)
+        except OSError as e:
+            print(f"[ERROR] {args.baseline}: {e}", file=sys.stderr)
+            return 1
+        findings = flag_regressions(agg, baseline_rows, args.threshold,
+                                    host_fingerprint())
+        print("\nbaseline comparison "
+              f"(threshold {args.threshold:g}, host {host_fingerprint()!r})")
+        bad = False
+        for f in findings:
+            if f["status"] == "REGRESSION":
+                bad = True
+                print(f"  REGRESSION {f['metric']}: {f['current']} vs "
+                      f"pinned {f['pinned']} ({f['ratio']}x)")
+            elif f["status"] == "ok":
+                print(f"  ok         {f['metric']}: {f['current']} vs "
+                      f"pinned {f['pinned']} ({f['ratio']}x)")
+            else:
+                print(f"  {f['status']:<10} {f['metric']}: {f['reason']}")
+        if bad:
+            return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

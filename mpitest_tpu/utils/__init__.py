@@ -1,1 +1,1 @@
-from mpitest_tpu.utils import io, metrics, trace  # noqa: F401
+from mpitest_tpu.utils import io, metrics, spans, trace  # noqa: F401

@@ -18,6 +18,43 @@ import os
 _provisioned: int | None = None
 
 
+def host_fingerprint() -> str:
+    """Provenance identifier for host-dependent pinned numbers (ADVICE
+    round 5): CPU model + core count — what actually determines the
+    native backend's throughput.  Hostnames are useless here (container
+    names are random); a CPU fingerprint survives container rebuilds on
+    the same machine class and differs where the numbers would differ.
+    Shared by bench.py (CANONICAL_NATIVE_MKEYS gate) and the report
+    CLI's baseline comparison."""
+    fields = {}
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if ":" not in line:
+                    continue
+                k, v = line.split(":", 1)
+                k, v = k.strip(), v.strip()
+                # first processor block only; VMs often report model
+                # name "unknown", so keep vendor/family/model numbers too
+                if k in ("vendor_id", "cpu family", "model", "model name"
+                         ) and k not in fields:
+                    fields[k] = v
+    except OSError:
+        pass
+    name = fields.get("model name", "")
+    if name and name != "unknown":
+        cpu = name
+    elif fields:
+        cpu = "-".join(filter(None, (fields.get("vendor_id"),
+                                     fields.get("cpu family"),
+                                     fields.get("model"))))
+    else:
+        import platform as _platform
+
+        cpu = _platform.processor() or _platform.machine() or "unknown-cpu"
+    return f"{cpu}/{os.cpu_count()}c"
+
+
 def _backend_initialized() -> bool:
     # jax.devices() would *create* the backend; peek at the registry
     # instead (private, but the only non-initializing probe there is —

@@ -1,0 +1,232 @@
+"""Structured span tracing — the nested telemetry layer of SURVEY.md §5.
+
+The flat ``Tracer.phases`` dict answers "how long did phase X take in
+total"; it cannot answer "which radix pass, which collective, which jit
+compile".  This module adds the structured counterpart: nested ``Span``
+events (name, parent, t0/dt, attrs) accumulated by a :class:`SpanLog`
+that the existing :class:`~mpitest_tpu.utils.trace.Tracer` owns, emitted
+three ways:
+
+* **JSONL event stream** — one self-contained JSON object per completed
+  span, appended live to ``SORT_TRACE=<path>`` (the native backends'
+  ``COMM_STATS`` sidecar is the same schema family; see
+  ``comm/comm_stats.h`` and :mod:`mpitest_tpu.report`).
+* **Chrome trace-event export** — :meth:`SpanLog.to_chrome_trace`
+  produces the ``{"traceEvents": [...]}`` JSON that chrome://tracing and
+  Perfetto open directly.
+* **In-process** — ``SpanLog.spans`` for tests and the report CLI.
+
+Device-side granularity contract: collectives and radix passes execute
+inside ONE fused XLA program, so they are not individually host-timable
+— their wall time lives in the enclosing ``jit`` span, and per-op device
+timing remains ``SORT_PROFILE``'s job (``jax.profiler``).  What IS
+knowable per collective — and what the MPI-vs-ICI comparison needs — is
+the static byte/shape accounting, so ``parallel/collectives.py`` and the
+SPMD models emit **trace-time point events** (``dt == 0``) carrying
+exact byte counts, nested under the jit span whose compile traced them.
+A warm (cache-hit) jit call re-emits nothing; the report CLI aggregates
+per compiled program, exactly like ``COMM_STATS`` aggregates per native
+run.
+
+Thread model: one SpanLog per Tracer, single-threaded (the host driver
+is one process; native per-rank telemetry lives in the C backends).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+#: In-memory retention cap per SpanLog.  phases/counters accumulate by
+#: design across runs on a reused Tracer, but retaining every span of
+#: every warm run forever would grow without bound (~a dozen spans per
+#: sort); past the cap spans still STREAM to SORT_TRACE and still time
+#: correctly — they are just not retained for in-process export, and
+#: ``SpanLog.dropped`` counts them.
+MAX_RETAINED_SPANS = 65_536
+
+#: Version tag stamped on every JSONL line so the report CLI can reject
+#: files from a future incompatible schema instead of misparsing them.
+SCHEMA = "span.v1"
+
+#: TPU collective -> its native comm.h twin (SURVEY.md §2.3 census) —
+#: the shared vocabulary that lets `python -m mpitest_tpu.report` line
+#: up TPU span rows against the C backends' COMM_STATS rows.
+MPI_EQUIV = {
+    "ragged_all_to_all": "alltoallv",
+    "all_to_all": "alltoall",
+    "all_gather": "allgather",
+    "psum": "allreduce",
+    "pmax": "allreduce",
+}
+
+
+@dataclass
+class Span:
+    """One event: a timed interval (``dt >= 0``) or a point event
+    (``dt == 0`` — trace-time collective/pass records)."""
+
+    name: str
+    id: int
+    parent: int | None
+    t0: float               # seconds, process-relative (perf_counter)
+    dt: float = 0.0
+    attrs: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "v": SCHEMA, "name": self.name, "id": self.id,
+            "parent": self.parent, "t0": round(self.t0, 9),
+            "dt": round(self.dt, 9), "attrs": self.attrs,
+        }
+
+
+#: Stack of SpanLogs with an open span — `emit()` targets the top one.
+#: Module-level so trace-time code (collectives, SPMD models) needs no
+#: plumbed-through handle: whatever sort() is running owns the log.
+_ACTIVE: list["SpanLog"] = []
+
+
+def current_log() -> "SpanLog | None":
+    return _ACTIVE[-1] if _ACTIVE else None
+
+
+def emit(name: str, **attrs) -> None:
+    """Record a point event on the active SpanLog (no-op when tracing is
+    off) — the one-line hook the parallel/model layers call."""
+    log = current_log()
+    if log is not None:
+        log.event(name, **attrs)
+
+
+def maybe_span(name: str, **attrs):
+    """Span twin of :func:`emit`: a span on the active log, or a no-op
+    context manager when tracing is off — what instrumented SPMD model
+    code opens around trace-time regions (radix passes, splitter
+    rounds)."""
+    log = current_log()
+    if log is None:
+        return contextlib.nullcontext()
+    return log.span(name, **attrs)
+
+
+class SpanLog:
+    """Accumulates nested spans; exports JSONL and Chrome trace-event.
+
+    ``stream_path``: when set, every completed span appends one JSON
+    line immediately (the ``SORT_TRACE`` contract — a crash loses only
+    the spans still open, and multiple runs append like any JSONL).
+    """
+
+    def __init__(self, stream_path: str | None = None):
+        self.spans: list[Span] = []
+        self.stream_path = stream_path
+        self.dropped = 0       # spans past MAX_RETAINED_SPANS (streamed only)
+        self._stack: list[int] = []
+        self._next_id = 0
+
+    # -- recording ----------------------------------------------------
+    def _new(self, name: str, attrs: dict) -> Span:
+        s = Span(
+            name=name, id=self._next_id,
+            parent=self._stack[-1] if self._stack else None,
+            t0=time.perf_counter(), attrs=attrs,
+        )
+        self._next_id += 1
+        return s
+
+    def _retain(self, s: Span) -> None:
+        if len(self.spans) < MAX_RETAINED_SPANS:
+            self.spans.append(s)
+        else:
+            self.dropped += 1
+
+    def event(self, name: str, **attrs) -> Span:
+        """Point event (dt=0) under the currently open span."""
+        s = self._new(name, attrs)
+        self._retain(s)
+        self._flush(s)
+        return s
+
+    @contextmanager
+    def span(self, name: str, **attrs):
+        """Timed interval; nests under the enclosing open span.  The
+        outermost span activates this log for module-level `emit()`."""
+        s = self._new(name, attrs)
+        self._retain(s)
+        self._stack.append(s.id)
+        outermost = len(self._stack) == 1
+        if outermost:
+            _ACTIVE.append(self)
+        try:
+            yield s
+        finally:
+            s.dt = time.perf_counter() - s.t0
+            self._stack.pop()
+            if outermost and _ACTIVE and _ACTIVE[-1] is self:
+                _ACTIVE.pop()
+            self._flush(s)
+
+    def _flush(self, s: Span) -> None:
+        if self.stream_path:
+            with open(self.stream_path, "a") as f:
+                f.write(json.dumps(s.to_dict()) + "\n")
+
+    # -- export -------------------------------------------------------
+    def to_jsonl(self) -> str:
+        return "\n".join(json.dumps(s.to_dict()) for s in self.spans)
+
+    def dump(self, path: str) -> None:
+        """Append ALL spans as JSONL (for logs not opened streaming)."""
+        if self.spans:
+            with open(path, "a") as f:
+                f.write(self.to_jsonl() + "\n")
+
+    def to_chrome_trace(self) -> dict:
+        """Chrome trace-event JSON (loads in chrome://tracing/Perfetto).
+
+        Timed spans become ``"ph": "X"`` complete events; point events
+        become ``"ph": "i"`` instants.  Timestamps are microseconds on
+        the same process-relative clock the spans were recorded on.
+        """
+        events: list[dict] = [{
+            "name": "process_name", "ph": "M", "pid": 1, "tid": 1,
+            "args": {"name": "mpitest_tpu"},
+        }]
+        for s in self.spans:
+            args = {k: v for k, v in s.attrs.items()}
+            args["span_id"] = s.id
+            if s.parent is not None:
+                args["parent_id"] = s.parent
+            if s.dt:
+                events.append({
+                    "name": s.name, "ph": "X", "pid": 1, "tid": 1,
+                    "ts": s.t0 * 1e6, "dur": s.dt * 1e6, "args": args,
+                })
+            else:
+                events.append({
+                    "name": s.name, "ph": "i", "s": "t", "pid": 1,
+                    "tid": 1, "ts": s.t0 * 1e6, "args": args,
+                })
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    # -- aggregation (shared with the report CLI) ---------------------
+    def collective_totals(self) -> dict[str, dict[str, float]]:
+        """Per-collective ``{calls, bytes, seconds}`` — the SAME schema
+        the native backends dump at ``COMM_STATS`` (comm/comm_stats.h),
+        keyed by the comm.h name via :data:`MPI_EQUIV`.  ``seconds`` is
+        0.0 for trace-time point events (device-side wall time is not
+        per-op observable; see module docstring)."""
+        out: dict[str, dict[str, float]] = {}
+        for s in self.spans:
+            if s.name not in MPI_EQUIV:
+                continue
+            row = out.setdefault(
+                MPI_EQUIV[s.name], {"calls": 0, "bytes": 0, "seconds": 0.0})
+            row["calls"] += 1
+            row["bytes"] += int(s.attrs.get("bytes", 0))
+            row["seconds"] += s.dt
+        return out

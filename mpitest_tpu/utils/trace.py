@@ -17,6 +17,8 @@ import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 
+from mpitest_tpu.utils.spans import SpanLog
+
 
 @dataclass
 class Tracer:
@@ -34,6 +36,10 @@ class Tracer:
     level: int = 0
     phases: dict[str, float] = field(default_factory=dict)
     counters: dict[str, float] = field(default_factory=dict)
+    #: Structured span log (utils/spans.py): every ``phase()`` opens a
+    #: nested span here too, and sort() adds jit/collective/pass spans.
+    #: ``SORT_TRACE=<path>`` streams it as JSONL (wired in models/api.py).
+    spans: SpanLog = field(default_factory=SpanLog)
 
     # -- reference printf contract ------------------------------------
     def common(self, msg: str, min_level: int = 1) -> None:
@@ -64,13 +70,20 @@ class Tracer:
     @contextmanager
     def phase(self, name: str):
         t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            dt = time.perf_counter() - t0
-            self.phases[name] = self.phases.get(name, 0.0) + dt
-            if self.level >= 1:
-                print(f"[VERBOSE] phase {name}: {dt*1e3:.3f} ms")
+        with self.spans.span(f"phase:{name}"):
+            try:
+                yield
+            finally:
+                dt = time.perf_counter() - t0
+                self.phases[name] = self.phases.get(name, 0.0) + dt
+                if self.level >= 1:
+                    print(f"[VERBOSE] phase {name}: {dt*1e3:.3f} ms")
+
+    def span(self, name: str, **attrs):
+        """Nested structured span (see :mod:`mpitest_tpu.utils.spans`) —
+        the finer-grained sibling of :meth:`phase` for events that need
+        identity and attributes, not just accumulated wall time."""
+        return self.spans.span(name, **attrs)
 
 
 @contextmanager

@@ -21,6 +21,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from mpitest_tpu.models import radix_sort, sample_sort
 from mpitest_tpu.parallel.mesh import AXIS
+from mpitest_tpu import compat
 
 
 @pytest.fixture(scope="module")
@@ -50,7 +51,7 @@ def test_aot_radix_v5e8(v5e8_mesh):
         out, mc = radix_sort.radix_sort_spmd(words, 1, 16, 8, cap, 2)
         return out[0], mc
 
-    fn = jax.shard_map(step, mesh=v5e8_mesh, in_specs=((P(AXIS),),),
+    fn = compat.shard_map(step, mesh=v5e8_mesh, in_specs=((P(AXIS),),),
                        out_specs=(P(AXIS), P()))
     compiled = jax.jit(fn).lower((_sharded_input(v5e8_mesh, n),)).compile()
     assert compiled is not None
@@ -67,7 +68,7 @@ def test_aot_sample_pallas_v5e8(v5e8_mesh):
             words, 1, 8, cap, 15, pack="pallas", engine="bitonic")
         return out[0], cnt[None], mc
 
-    fn = jax.shard_map(step, mesh=v5e8_mesh, in_specs=((P(AXIS),),),
+    fn = compat.shard_map(step, mesh=v5e8_mesh, in_specs=((P(AXIS),),),
                        out_specs=(P(AXIS), P(AXIS), P()), check_vma=False)
     compiled = jax.jit(fn).lower((_sharded_input(v5e8_mesh, n),)).compile()
     assert compiled is not None
@@ -87,7 +88,7 @@ def test_aot_pair_engine_v5e8(v5e8_mesh):
             words, 2, 8, cap, 15, pack="pallas", engine="bitonic")
         return out[0], out[1], cnt[None], mc
 
-    fn = jax.shard_map(step, mesh=v5e8_mesh, in_specs=((P(AXIS), P(AXIS)),),
+    fn = compat.shard_map(step, mesh=v5e8_mesh, in_specs=((P(AXIS), P(AXIS)),),
                        out_specs=(P(AXIS), P(AXIS), P(AXIS), P()),
                        check_vma=False)
     words = (_sharded_input(v5e8_mesh, n), _sharded_input(v5e8_mesh, n))
@@ -108,7 +109,7 @@ def test_aot_pair_local_fused_v5e8(v5e8_mesh):
         (1 << 14,), jnp.int64,
         sharding=NamedSharding(Mesh(np.array([dev]), (AXIS,)), P()),
     )
-    with jax.enable_x64(True):
+    with compat.enable_x64(True):
         fn = _compile_pair_fused("int64", "bitonic")
         assert fn.lower(x).compile() is not None
 
@@ -132,7 +133,7 @@ def test_aot_radix_v5e16_two_slices():
         out, mc = radix_sort.radix_sort_spmd(words, 1, 16, n_chips, cap, 2)
         return out[0], mc
 
-    fn = jax.shard_map(step, mesh=mesh, in_specs=((P(AXIS),),),
+    fn = compat.shard_map(step, mesh=mesh, in_specs=((P(AXIS),),),
                        out_specs=(P(AXIS), P()))
     x = jax.ShapeDtypeStruct((n_chips * n,), jnp.uint32,
                              sharding=NamedSharding(mesh, P(AXIS)))

@@ -184,7 +184,40 @@ def test_env_knob_garbage_fails_cleanly(knob, value, keyfile, capsys,
     out = capsys.readouterr()
     assert out.err.startswith("[ERROR] "), out.err
     assert len(out.err.strip().splitlines()) == 1
-    assert knob in out.err or "SORT_CAP_FACTOR" in out.err
+    # per-knob contract: the message names the offending knob AND echoes
+    # the offending value (the round-5 satellite split the old combined
+    # SORT_CAP_FACTOR/SORT_OVERSAMPLE message)
+    assert knob in out.err
+    assert repr(value) in out.err or value in out.err
+
+
+def test_sort_trace_and_chrome_export_cli(tmp_path, capsys, monkeypatch, rng):
+    """SORT_TRACE streams a schema-clean span JSONL and SORT_TRACE_CHROME
+    writes loadable Chrome trace-event JSON from one CLI run — the
+    driver end of the ISSUE 1 telemetry layer.  Fresh N so the program
+    compiles in-run (collective spans are per-compile trace-time
+    records)."""
+    import json
+
+    from mpitest_tpu import report
+
+    keys = rng.integers(-(2**31), 2**31 - 1, size=1013, dtype=np.int32)
+    p = tmp_path / "keys.txt"
+    p.write_text("\n".join(str(k) for k in keys) + "\n")
+    trace = tmp_path / "trace.jsonl"
+    chrome = tmp_path / "trace_chrome.json"
+    monkeypatch.setenv("SORT_ALGO", "radix")
+    monkeypatch.setenv("SORT_TRACE", str(trace))
+    monkeypatch.setenv("SORT_TRACE_CHROME", str(chrome))
+    assert sort_cli.main(["sort_cli.py", str(p)]) == 0
+    capsys.readouterr()
+    rows = report.load_rows(str(trace))
+    assert report.check_rows(rows) == []
+    names = {r["name"] for r in rows}
+    assert {"sort", "radix_pass", "ragged_all_to_all"} <= names
+    ct = json.loads(chrome.read_text())
+    assert ct["traceEvents"] and any(e.get("ph") == "X"
+                                     for e in ct["traceEvents"])
 
 
 def test_debug_dump_sorted(keyfile, capsys, monkeypatch):

@@ -54,6 +54,34 @@ def test_needed_passes_digit12_sorts_correctly(mesh8):
     np.testing.assert_array_equal(got, np.sort(x))
 
 
+def test_bench_canonical_host_provenance_gate(monkeypatch, capsys, mesh8):
+    """ADVICE r5 satellite: a pinned CANONICAL_NATIVE_MKEYS row only
+    yields vs_canonical_native on the host class it was measured on;
+    elsewhere the row carries the skip reason instead of a silently
+    cross-host ratio."""
+    import bench
+    from mpitest_tpu.utils.platform import host_fingerprint
+
+    monkeypatch.setenv("BENCH_LOG2N", "12")
+    monkeypatch.setenv("BENCH_REPEATS", "1")
+    monkeypatch.setenv("BENCH_NATIVE_RANKS", "0")
+    key = ("radix", 12, "int32", 0)
+
+    monkeypatch.setitem(bench.CANONICAL_NATIVE_MKEYS, key,
+                        {"mkeys": 1.0, "host": "someone-elses-box/64c"})
+    bench.main()
+    row = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "vs_canonical_native" not in row
+    assert "someone-elses-box/64c" in row["vs_canonical_native_skipped"]
+
+    monkeypatch.setitem(bench.CANONICAL_NATIVE_MKEYS, key,
+                        {"mkeys": 1.0, "host": host_fingerprint()})
+    bench.main()
+    row = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert row["vs_canonical_native"] > 0
+    assert "vs_canonical_native_skipped" not in row
+
+
 def test_bench_driver_contract(tmp_path):
     """The driver scrapes exactly ONE JSON line from bench.py stdout with
     the metric/value/unit/vs_baseline fields.  Runs tiny on a 2-device

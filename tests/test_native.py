@@ -6,6 +6,7 @@ pthread ranks via COMM_RANKS), skew and non-divisible-N cases the
 reference gets wrong.
 """
 
+import json
 import shutil
 import subprocess
 from pathlib import Path
@@ -218,6 +219,82 @@ def test_native_usage_contract(algo, binaries):
     r = subprocess.run([binaries[algo]], capture_output=True, text=True)
     assert r.returncode != 0
     assert "Usage:" in r.stderr
+
+
+@pytest.mark.parametrize("ranks", [1, 4, 8])
+def test_comm_stats_selftest_schema(ranks, binaries, tmp_path):
+    """COMM_STATS=<path> makes comm_launch append ONE JSON line with the
+    shared per-collective schema (comm/comm_stats.h <-> utils/spans.py):
+    calls/bytes/seconds per collective, schema-checked by the report CLI
+    — ISSUE 1 acceptance: native runs feed the same aggregator as TPU
+    span streams."""
+    import os
+
+    from mpitest_tpu import report
+
+    r = subprocess.run(
+        ["make", "-C", str(REPO / "bench"), "BACKEND=local", "comm_selftest"],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stderr
+    stats = tmp_path / "comm_stats.jsonl"
+    r = subprocess.run(
+        [str(REPO / "bench" / "comm_selftest")],
+        capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, COMM_RANKS=str(ranks), COMM_STATS=str(stats)),
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    lines = stats.read_text().splitlines()
+    assert len(lines) == 1  # one record per comm_launch
+    obj = json.loads(lines[0])
+    assert obj["v"] == "comm_stats.v1"
+    assert obj["backend"] == "local" and obj["ranks"] == ranks
+    # the selftest exercises every collective once per rank
+    for coll in ("bcast", "scatter", "scatterv", "gather", "gatherv",
+                 "allgather", "allreduce", "exscan", "alltoall",
+                 "alltoallv", "barrier"):
+        c = obj["collectives"][coll]
+        assert c["calls"] >= ranks
+        assert c["seconds"] >= 0.0
+        if coll not in ("barrier",):
+            assert c["bytes"] > 0
+    rows = report.load_rows(str(stats))
+    assert report.check_rows(rows) == []
+    agg = report.aggregate(rows)
+    assert agg["collectives"][f"native/localx{ranks}"]["alltoallv"]["calls"] \
+        == ranks
+
+
+def test_comm_stats_sort_parity_local_vs_minimpi(binaries, minimpi_binaries,
+                                                 tmp_path, rng):
+    """The SAME sort on the pthreads and multi-process MPI backends must
+    produce identical per-collective calls/bytes in COMM_STATS (seconds
+    are wall time and may differ) — the cross-backend comparability the
+    telemetry layer exists for."""
+    import os
+
+    keys = rng.integers(-(2**31), 2**31 - 1, size=10_000, dtype=np.int32)
+    p = write_keys(tmp_path, keys)
+    s_local, s_mpi = tmp_path / "local.jsonl", tmp_path / "mpi.jsonl"
+    r = subprocess.run(
+        [binaries["radix"], str(p)], capture_output=True, text=True,
+        timeout=120,
+        env=dict(os.environ, COMM_RANKS="4", COMM_STATS=str(s_local)),
+    )
+    assert r.returncode == 0, r.stderr
+    r = subprocess.run(
+        [minimpi_binaries["radix"], str(p)], capture_output=True, text=True,
+        timeout=120,
+        env=dict(os.environ, MINIMPI_NP="4", COMM_STATS=str(s_mpi)),
+    )
+    assert r.returncode == 0, r.stderr
+    o_local = json.loads(s_local.read_text())
+    o_mpi = json.loads(s_mpi.read_text())
+    assert o_local["backend"] == "local" and o_mpi["backend"] == "mpi"
+    assert set(o_local["collectives"]) == set(o_mpi["collectives"])
+    for name, c in o_local["collectives"].items():
+        m = o_mpi["collectives"][name]
+        assert (c["calls"], c["bytes"]) == (m["calls"], m["bytes"]), name
 
 
 @pytest.mark.parametrize("ranks", [1, 4, 8])

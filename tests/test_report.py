@@ -1,0 +1,152 @@
+"""Report CLI tests: mixed-schema aggregation, --check validation, and
+regression flagging with host-provenance gating (ISSUE 1 satellite)."""
+
+import json
+
+import pytest
+
+from mpitest_tpu import report
+
+
+def write_jsonl(path, rows):
+    path.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    return str(path)
+
+
+SPAN_ROWS = [
+    {"v": "span.v1", "name": "sort", "id": 0, "parent": None,
+     "t0": 0.0, "dt": 2.0, "attrs": {"algorithm": "radix"}},
+    {"v": "span.v1", "name": "phase:sort", "id": 1, "parent": 0,
+     "t0": 0.1, "dt": 1.5, "attrs": {}},
+    {"v": "span.v1", "name": "ragged_all_to_all", "id": 2, "parent": 1,
+     "t0": 0.2, "dt": 0.0, "attrs": {"bytes": 4096, "ranks": 4}},
+    {"v": "span.v1", "name": "all_gather", "id": 3, "parent": 1,
+     "t0": 0.3, "dt": 0.0, "attrs": {"bytes": 1024}},
+]
+
+COMM_ROW = {"v": "comm_stats.v1", "backend": "local", "ranks": 4,
+            "collectives": {"alltoallv": {"calls": 16, "bytes": 320000,
+                                          "seconds": 0.001}}}
+
+METRICS_ROW = {"ts": 1.0, "config": {"algo": "radix"},
+               "metrics": {"phase_sort_ms": {"value": 250.0, "unit": "ms"},
+                           "sort_mkeys_per_s": {"value": 700.0,
+                                                "unit": "Mkeys/s"}}}
+
+BENCH_ROW = {"metric": "radix_sort_mkeys_per_s_2e28_int32", "value": 766.7,
+             "unit": "Mkeys/s", "vs_baseline": 60.7}
+
+
+def test_load_classifies_all_schemas(tmp_path):
+    p = write_jsonl(tmp_path / "mixed.jsonl",
+                    SPAN_ROWS + [COMM_ROW, METRICS_ROW, BENCH_ROW])
+    kinds = [r["kind"] for r in report.load_rows(p)]
+    assert kinds == ["span"] * 4 + ["comm_stats", "metrics", "bench"]
+
+
+def test_aggregate_lines_up_tpu_and_native(tmp_path):
+    p = write_jsonl(tmp_path / "mixed.jsonl",
+                    SPAN_ROWS + [COMM_ROW, METRICS_ROW, BENCH_ROW])
+    agg = report.aggregate(report.load_rows(p))
+    # phases fold spans AND metrics sidecar rows (ms)
+    assert agg["phases"]["sort"]["count"] == 2
+    assert agg["phases"]["sort"]["ms"] == pytest.approx(1750.0)
+    # the TPU span events land on the comm.h vocabulary next to native
+    assert agg["collectives"]["tpu"]["alltoallv"]["bytes"] == 4096
+    assert agg["collectives"]["tpu"]["allgather"]["calls"] == 1
+    assert agg["collectives"]["native/localx4"]["alltoallv"]["calls"] == 16
+    assert agg["metrics"]["sort_mkeys_per_s"]["value"] == 700.0
+    assert agg["metrics"][BENCH_ROW["metric"]]["value"] == 766.7
+    # renders without error
+    text = report.render(agg)
+    assert "alltoallv" in text and "native/localx4" in text
+
+
+def test_check_clean_and_violations(tmp_path):
+    clean = write_jsonl(tmp_path / "clean.jsonl", SPAN_ROWS + [COMM_ROW])
+    assert report.check_rows(report.load_rows(clean)) == []
+
+    bad_rows = [
+        {"v": "span.v1", "name": "x", "id": 0, "parent": 7,   # dangling
+         "t0": 0.0, "dt": 0.1, "attrs": {}},
+        {"v": "span.v1", "name": "y", "id": 1, "parent": None,
+         "t0": 0.0, "dt": 0.1},                               # no attrs
+        {"v": "comm_stats.v1", "backend": "local", "ranks": 4,
+         "collectives": {"bcast": {"calls": 1, "bytes": 2}}},  # no seconds
+        {"weird": True},                                       # unknown
+    ]
+    bad = write_jsonl(tmp_path / "bad.jsonl", bad_rows)
+    errors = report.check_rows(report.load_rows(bad))
+    assert len(errors) == 4
+    joined = "\n".join(errors)
+    assert "dangling parent" in joined
+    assert "missing 'attrs'" in joined
+    assert "missing 'seconds'" in joined
+    assert "unrecognized record shape" in joined
+
+    # invalid JSON is a check error too, with file:line
+    garbled = tmp_path / "garbled.jsonl"
+    garbled.write_text('{"metric": "m", "value": 1}\nnot json\n')
+    errors = report.check_rows(report.load_rows(str(garbled)))
+    assert len(errors) == 1 and "not valid JSON" in errors[0]
+
+
+def test_main_check_exit_codes(tmp_path, capsys):
+    clean = write_jsonl(tmp_path / "clean.jsonl", SPAN_ROWS)
+    assert report.main(["--check", clean]) == 0
+    assert "telemetry check OK" in capsys.readouterr().out
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text("nope\n")
+    assert report.main(["--check", str(bad)]) == 1
+
+
+def test_regression_flagging(tmp_path):
+    current = report.aggregate(report.load_rows(
+        write_jsonl(tmp_path / "cur.jsonl", [BENCH_ROW])))
+    host = "this-host/8c"
+    baseline = [
+        # clear regression: 766.7 < 0.9 * 900
+        {"metric": BENCH_ROW["metric"], "value": 900.0, "host": host},
+        # other-host pin must be SKIPPED, not compared
+        {"metric": BENCH_ROW["metric"], "value": 9999.0,
+         "host": "other-host/1c"},
+        # unpinned-host row compares everywhere; passes at 700 pinned
+        {"metric": BENCH_ROW["metric"], "value": 700.0},
+        # pinned metric with no current row
+        {"metric": "absent_metric", "value": 1.0, "host": host},
+    ]
+    for row in baseline:
+        row.update(unit="Mkeys/s")
+    rows = report.load_rows(write_jsonl(tmp_path / "base.jsonl", baseline))
+    findings = report.flag_regressions(current, rows, 0.9, host)
+    status = [f["status"] for f in findings]
+    assert status == ["REGRESSION", "skipped", "ok", "missing"]
+    assert findings[0]["ratio"] == pytest.approx(766.7 / 900.0, abs=1e-3)
+    assert "host mismatch" in findings[1]["reason"]
+
+
+def test_main_regression_exit_code(tmp_path, capsys):
+    cur = write_jsonl(tmp_path / "cur.jsonl", [BENCH_ROW])
+    base = write_jsonl(tmp_path / "base.jsonl",
+                       [{"metric": BENCH_ROW["metric"], "value": 9000.0}])
+    rc = report.main([cur, "--baseline", base])
+    assert rc == 2
+    out = capsys.readouterr().out
+    assert "REGRESSION" in out
+    # threshold loose enough -> ok, exit 0
+    ok_base = write_jsonl(tmp_path / "ok.jsonl",
+                          [{"metric": BENCH_ROW["metric"], "value": 766.0}])
+    assert report.main([cur, "--baseline", ok_base]) == 0
+
+
+def test_main_aggregates_baseline_results_default(tmp_path, capsys,
+                                                  monkeypatch):
+    """With no files, the CLI reads bench/BASELINE_RESULTS.jsonl — the
+    pinned measurement history rides the same report path."""
+    bench_dir = tmp_path / "bench"
+    bench_dir.mkdir()
+    write_jsonl(bench_dir / "BASELINE_RESULTS.jsonl", [BENCH_ROW, COMM_ROW])
+    monkeypatch.chdir(tmp_path)
+    assert report.main([]) == 0
+    out = capsys.readouterr().out
+    assert BENCH_ROW["metric"] in out and "alltoallv" in out

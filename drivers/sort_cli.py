@@ -25,6 +25,16 @@ of the reference's fixed ``1.5*size_bucket`` bucket cap,
 ``mpi_sample_sort.c:140``), ``SORT_OVERSAMPLE`` (samples per shard for
 splitter selection, default ``2P-1`` like the reference ``:90``).
 
+Streaming ingest (ISSUE 2 — on by default for large inputs): the file
+format is sniffed once (``read_keys_auto``) and SORTBIN1 inputs open as
+an mmap (no upfront materialization); the sort's host path then runs the
+chunked parse/encode/DMA pipeline (``mpitest_tpu/models/ingest.py``),
+emitting ``ingest.*`` / ``egress.*`` spans into ``SORT_TRACE``.  Knobs:
+``SORT_INGEST`` ∈ {auto, stream, mono} (auto streams above ~32 MiB),
+``SORT_INGEST_CHUNK`` (keys per chunk, default 2^22),
+``SORT_INGEST_THREADS`` (parse/encode workers, default 2) — all
+validated fail-fast like every other knob.
+
 Observability (SURVEY.md §5 metrics row — additions the reference
 lacks, off by default so the byte-compatible contract is untouched):
 ``SORT_METRICS=<path>`` appends one JSON sidecar line per run (phase ms,
@@ -69,7 +79,7 @@ def main(argv: list[str] | None = None) -> int:
 
     from mpitest_tpu.models.api import sort
     from mpitest_tpu.parallel.mesh import make_mesh
-    from mpitest_tpu.utils.io import read_keys_text
+    from mpitest_tpu.utils import io as kio
     from mpitest_tpu.utils.trace import Tracer, jax_profile
 
     # Env-knob validation: any garbage value is one clean `[ERROR]` line
@@ -139,9 +149,25 @@ def main(argv: list[str] | None = None) -> int:
         if oversample < 1:
             knob_error(f"SORT_OVERSAMPLE={ov_env!r}: use an integer >= 1")
             return 1
+    # Ingest-pipeline knobs (SORT_INGEST / SORT_INGEST_CHUNK /
+    # SORT_INGEST_THREADS / SORT_DONATE): the library readers raise
+    # ValueError with a knob-naming message; surface it through the same
+    # fail-fast contract.
+    try:
+        kio.ingest_mode()
+        kio.ingest_chunk_elems()
+        kio.ingest_threads()
+        kio.donate_setting()
+    except ValueError as e:
+        knob_error(str(e))
+        return 1
 
     try:
-        keys = read_keys_text(path, dtype=dtype)
+        # One magic sniff; SORTBIN1 opens as an mmap so the streaming
+        # ingest pages keys in chunk-by-chunk instead of materializing
+        # the file up front (text parses through the threaded chunk
+        # reader).
+        keys = kio.read_keys_auto(path, dtype=dtype, mmap=True)
     except (OSError, ValueError):
         print(f"sort(): '{path}' is not a valid file for read.", file=sys.stderr)
         return 1
@@ -174,7 +200,9 @@ def main(argv: list[str] | None = None) -> int:
             cap_factor=cap_factor, oversample=oversample,
             tracer=tracer, return_result=True,
         )
-        out = res.to_numpy()  # materialize = the reference's final Gatherv
+        # materialize = the reference's final Gatherv (streamed egress
+        # above the auto threshold: decode overlaps the shard fetches)
+        out = res.to_numpy(tracer=tracer)
     end = time.perf_counter()
 
     chrome_path = os.environ.get("SORT_TRACE_CHROME")

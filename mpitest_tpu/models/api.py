@@ -41,9 +41,18 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from mpitest_tpu import compat
 from mpitest_tpu.models import radix_sort, sample_sort
+from mpitest_tpu.models.ingest import (
+    EGRESS_MIN_BYTES as _EGRESS_MIN_BYTES,
+    StagedIngest,
+    checked_device_put,
+    stream_result_to_numpy,
+    stream_to_mesh,
+    use_stream,
+)
 from mpitest_tpu.ops import bitonic, kernels
 from mpitest_tpu.ops.keys import codec_for
 from mpitest_tpu.parallel.mesh import AXIS, key_sharding, make_mesh
+from mpitest_tpu.utils import io as kio
 from mpitest_tpu.utils.trace import Tracer
 
 
@@ -80,13 +89,34 @@ class DistributedSortResult:
     counts: np.ndarray | None = None  # per-shard valid counts (ragged layouts)
     shard_slots: int | None = None    # slots per shard for ragged layouts
 
-    def to_numpy(self) -> np.ndarray:
+    def to_numpy(self, tracer: "Tracer | None" = None) -> np.ndarray:
         if self.n_valid == 0:
             return np.empty(0, self.dtype)
         codec = codec_for(self.dtype)
-        host = tuple(np.asarray(w) for w in self.words)
         if self.counts is None:
+            # Streamed egress (models/ingest.py): decode shard k while
+            # shard k+1 is still fetching — on by default above the
+            # auto threshold, forced by SORT_INGEST=stream, disabled by
+            # =mono.  Ragged (sample) results keep the legacy gather.
+            try:
+                # multi-host arrays only expose local shards here; the
+                # streamed decode cannot cover the rest, so those fall
+                # through to the legacy gather (which raises loudly).
+                n_shards = (len(self.words[0].addressable_shards)
+                            if self.words[0].is_fully_addressable else 1)
+            except Exception:
+                n_shards = 1
+            mode = kio.ingest_mode()
+            nbytes = self.n_valid * np.dtype(self.dtype).itemsize
+            if n_shards > 1 and (
+                mode == "stream"
+                or (mode == "auto" and nbytes >= _EGRESS_MIN_BYTES)
+            ):
+                return stream_result_to_numpy(
+                    self.words, self.n_valid, self.dtype, tracer=tracer)
+            host = tuple(np.asarray(w) for w in self.words)
             return codec.decode(tuple(w[: self.n_valid] for w in host))
+        host = tuple(np.asarray(w) for w in self.words)
         # ragged: concatenate the valid prefix of each shard's slot range,
         # then drop the padding sentinels (global max ⇒ they sit at the tail)
         parts = []
@@ -551,7 +581,7 @@ def _compile_local(n_words: int, engine: str = "auto"):
 
 @lru_cache(maxsize=64)
 def _compile_radix(mesh: Mesh, n_words: int, n: int, digit_bits: int, cap: int,
-                   passes: int, pack: str):
+                   passes: int, pack: str, donate: bool = False):
     n_ranks = mesh.devices.size
 
     def f(*words):
@@ -569,13 +599,19 @@ def _compile_radix(mesh: Mesh, n_words: int, n: int, digit_bits: int, cap: int,
             # pallas_call's internal ops mix varying/unvarying operands in
             # ways the vma checker rejects; out_specs are explicit here.
             check_vma=(pack == "xla"),
-        )
+        ),
+        # Donation: the input word shards alias the output word shards
+        # (same shape/dtype/sharding), so HBM holds ONE copy of the keys
+        # during the sort instead of two — the streamed-ingest memory
+        # contract.  Callers rebuild words before any overflow retry
+        # (the donated buffers are dead after the call).
+        donate_argnums=tuple(range(n_words)) if donate else (),
     )
 
 
 @lru_cache(maxsize=64)
 def _compile_sample(mesh: Mesh, n_words: int, n: int, cap: int, oversample: int,
-                    pack: str, engine: str = "lax"):
+                    pack: str, engine: str = "lax", donate: bool = False):
     n_ranks = mesh.devices.size
 
     def f(*words):
@@ -593,8 +629,24 @@ def _compile_sample(mesh: Mesh, n_words: int, n: int, cap: int, oversample: int,
             # pallas_call internals (exchange pack, bitonic engine) mix
             # varying/unvarying operands in ways the vma checker rejects.
             check_vma=(pack == "xla" and engine == "lax"),
-        )
+        ),
+        # see _compile_radix: input/output word aliasing under donation
+        # ([P*(P*cap)] outputs differ in shape from [P*n] inputs, so XLA
+        # may only reuse rather than alias — still a net HBM win).
+        donate_argnums=tuple(range(n_words)) if donate else (),
     )
+
+
+def _donation_enabled() -> bool:
+    """Buffer donation on the sort dispatch: ``SORT_DONATE`` ∈
+    {auto, 1, 0} (validated in one place, ``utils.io.donate_setting``).
+    ``auto`` donates on real TPU backends only — that is where the
+    aliasing saves HBM; CPU donation saves nothing and (on some jaxlib
+    versions) emits an unusable-donation warning on every compile."""
+    v = kio.donate_setting()
+    if v == "auto":
+        return jax.default_backend() == "tpu"
+    return v == "1"
 
 
 #: Recv-memory bound for the sample-sort exchange, in units of the fair
@@ -780,6 +832,35 @@ def _device_mem_high_water(span, mesh: Mesh | None) -> None:
         pass
 
 
+def ingest_to_mesh(
+    x,
+    mesh: Mesh | None = None,
+    tracer: Tracer | None = None,
+    chunk_elems: int | None = None,
+    threads: int | None = None,
+) -> StagedIngest:
+    """Public streaming-ingest entry: run the chunked, double-buffered
+    parse→encode→DMA pipeline (:mod:`mpitest_tpu.models.ingest`) over
+    host keys ``x`` and return the :class:`StagedIngest` that
+    :func:`sort` accepts in place of raw keys (skipping its own
+    encode/pad/device_put entirely).  Every host→device transfer goes
+    through the dtype-preservation guard (:func:`checked_device_put`).
+
+    ``SORT_TRACE`` streaming applies here exactly as in :func:`sort`, so
+    the ``ingest.*`` stage spans land in the same JSONL the report CLI
+    aggregates."""
+    if mesh is None:
+        mesh = make_mesh()
+    tracer = tracer or Tracer()
+    trace_path = os.environ.get("SORT_TRACE")
+    if trace_path and tracer.spans.stream_path is None:
+        tracer.spans.stream_path = trace_path
+    with tracer.spans.span("ingest", n=int(np.asarray(x).size),
+                           dtype=str(np.asarray(x).dtype)):
+        return stream_to_mesh(x, mesh, tracer=tracer,
+                              chunk_elems=chunk_elems, threads=threads)
+
+
 def sort(
     x,
     algorithm: str = "radix",
@@ -793,6 +874,13 @@ def sort(
 ):
     """Sort integer keys on the mesh; returns a sorted numpy array
     (or the device-resident :class:`DistributedSortResult`).
+
+    ``x`` may be a host array, a device-resident ``jax.Array``, or a
+    :class:`StagedIngest` from :func:`ingest_to_mesh` (pre-encoded,
+    pre-sharded words — the streaming pipeline's output).  Large host
+    arrays automatically ride the same pipeline (``SORT_INGEST`` knob:
+    auto/stream/mono); on TPU the staged word buffers are donated to the
+    SPMD program so device memory holds one copy of the keys, not two.
 
     Telemetry: the run accumulates a structured span log on
     ``tracer.spans`` (:mod:`mpitest_tpu.utils.spans`) — nested phases,
@@ -864,12 +952,29 @@ def _sort_impl(
     respect to the bits actually resident on the device; host-input
     float64 is bit-exact, full stop.
     """
-    is_device = isinstance(x, jax.Array)
-    if not is_device:
-        x = np.asarray(x)
-    dtype = np.dtype(x.dtype)
-    codec = codec_for(dtype)
-    N = int(x.size)
+    staged = x if isinstance(x, StagedIngest) else None
+    if staged is not None:
+        if staged.consumed:
+            raise ValueError(
+                "StagedIngest was already consumed by a donated sort "
+                "dispatch (its word buffers now belong to XLA); call "
+                ".rebuild() or ingest_to_mesh() again for another sort")
+        is_device = False
+        dtype = staged.dtype
+        codec = codec_for(dtype)
+        N = staged.n_valid
+        if mesh is None:
+            mesh = staged.mesh
+        elif mesh != staged.mesh:  # equality, not identity: make_mesh()
+            raise ValueError(      # builds equal-but-distinct Mesh objects
+                "StagedIngest was streamed onto a different mesh")
+    else:
+        is_device = isinstance(x, jax.Array)
+        if not is_device:
+            x = np.asarray(x)
+        dtype = np.dtype(x.dtype)
+        codec = codec_for(dtype)
+        N = int(x.size)
     if N == 0:
         out = np.empty(0, dtype)
         return out if not return_result else DistributedSortResult((), 0, dtype)
@@ -878,7 +983,21 @@ def _sort_impl(
     n_ranks = int(mesh.devices.size)
     n = max(1, math.ceil(N / n_ranks))
 
-    if n_ranks == 1 and algorithm in ("radix", "sample"):
+    if staged is not None and n_ranks == 1:
+        # 1-device mesh with pre-staged words: one fused local sort of
+        # the padded shard (pads replicate the max key, so they sort to
+        # the tail past n_valid — same contract as the host local path).
+        with tracer.phase("sort"):
+            out = _traced_call(
+                tracer, "local",
+                _compile_local(codec.n_words, _local_engine()), *staged.words)
+        res = DistributedSortResult(out, N, dtype)
+        if return_result:
+            return res
+        with tracer.phase("decode"):
+            return res.to_numpy(tracer=tracer)
+
+    if staged is None and n_ranks == 1 and algorithm in ("radix", "sample"):
         engine = _local_engine()
         if (codec.n_words == 2 and engine != "lax"
                 and N >= (1 << bitonic.MIN_SORT_LOG2)
@@ -891,7 +1010,7 @@ def _sort_impl(
             if return_result:
                 return res
             with tracer.phase("decode"):
-                return res.to_numpy()
+                return res.to_numpy(tracer=tracer)
         tracer.counters["local_engine"] = (
             "bitonic" if _use_bitonic(engine, codec.n_words, N)
             else "lax"
@@ -930,33 +1049,52 @@ def _sort_impl(
         if return_result:
             return res
         with tracer.phase("decode"):
-            return res.to_numpy()
+            return res.to_numpy(tracer=tracer)
 
-    if is_device and _f64_known_broken(_mesh_platform(mesh), dtype, codec):
-        x, is_device = _f64_host_input(x, tracer), False
-    if is_device:
+    #: per-word max^min already known without touching the data again
+    #: (streamed ingest folds it chunk-by-chunk); None = plan from
+    #: words_np or a device reduction as before.
+    plan_diffs: tuple[int, ...] | None = None
+    #: re-create the sharded input words after a *donated* dispatch
+    #: consumed them (overflow retry / skew reroute); None disables
+    #: donation for this input.
+    rebuild_words = None
+
+    if staged is not None:
+        words = staged.words
         words_np = None
+        plan_diffs = staged.word_diffs
+        if staged.source is not None:
+            rebuild_words = lambda: staged.rebuild().words  # noqa: E731
+    if staged is None and is_device and _f64_known_broken(
+            _mesh_platform(mesh), dtype, codec):
+        x, is_device = _f64_host_input(x, tracer), False
+    if staged is None and is_device:
+        words_np = None
+
+        def _device_encode_words():
+            x_flat = x.reshape(-1)
+            if N == n_ranks * n:
+                # Land the input on the mesh first (no-op when already
+                # sharded there); a committed single-device array would
+                # otherwise conflict with the jit's mesh-wide
+                # out_shardings.
+                x_flat = jax.device_put(x_flat, key_sharding(mesh))
+                return _traced_call(
+                    tracer, "encode_pad",
+                    _compile_encode_pad(dtype.name, N, mesh), x_flat)
+            # Uneven N cannot be mesh-sharded directly; encode+pad
+            # wherever the input lives, then land the even result.
+            ws = _traced_call(
+                tracer, "encode_pad",
+                _compile_encode_pad(dtype.name, n_ranks * n, None),
+                x_flat)
+            return tuple(jax.device_put(w, key_sharding(mesh)) for w in ws)
+
         try:
             with tracer.phase("encode"):
-                x_flat = x.reshape(-1)
-                if N == n_ranks * n:
-                    # Land the input on the mesh first (no-op when already
-                    # sharded there); a committed single-device array would
-                    # otherwise conflict with the jit's mesh-wide
-                    # out_shardings.
-                    x_flat = jax.device_put(x_flat, key_sharding(mesh))
-                    words = _traced_call(
-                        tracer, "encode_pad",
-                        _compile_encode_pad(dtype.name, N, mesh), x_flat)
-                else:
-                    # Uneven N cannot be mesh-sharded directly; encode+pad
-                    # wherever the input lives, then land the even result.
-                    ws = _traced_call(
-                        tracer, "encode_pad",
-                        _compile_encode_pad(dtype.name, n_ranks * n, None),
-                        x_flat)
-                    words = tuple(jax.device_put(w, key_sharding(mesh))
-                                  for w in ws)
+                words = _device_encode_words()
+            rebuild_words = _device_encode_words
         except jax.errors.JaxRuntimeError as e:
             # see the single-device branch: f64->u32 bitcast gap on some
             # TPU stacks — degrade to one documented host round-trip.
@@ -965,17 +1103,41 @@ def _sort_impl(
             if not _is_f64_lowering_gap(e, dtype, codec, _mesh_platform(mesh)):
                 raise
             x, is_device = _f64_host_input(x, tracer), False
-    if not is_device:
-        with tracer.phase("encode"):
-            flat = x.reshape(-1)
-            words_np = codec.encode(flat)
-            pad = _host_pad_words(codec, flat, dtype, n_ranks * n)
+    if staged is None and not is_device:
+        flat = x.reshape(-1)
+        if use_stream(flat.nbytes):
+            # Streaming ingest (models/ingest.py): chunked parse/encode
+            # overlapped with per-shard DMA, bounded host memory, and
+            # the pass-planner diffs folded in flight — no second host
+            # pass over the keys.
+            with tracer.phase("ingest"):
+                st = stream_to_mesh(flat, mesh, tracer=tracer)
+            words = st.words
+            words_np = None
+            plan_diffs = st.word_diffs
+            rebuild_words = lambda: stream_to_mesh(  # noqa: E731
+                flat, mesh, tracer=tracer).words
+        else:
+            with tracer.phase("encode"):
+                words_np = codec.encode(flat)
+                pad = _host_pad_words(codec, flat, dtype, n_ranks * n)
 
-        with tracer.phase("device_put"):
-            words = _shard_input(words_np, mesh, n, pad)
+            with tracer.phase("device_put"):
+                words = _shard_input(words_np, mesh, n, pad)
+            rebuild_words = lambda: _shard_input(  # noqa: E731
+                words_np, mesh, n, pad)
 
     pack_impl = _resolve_pack(pack)
     align = _cap_align(pack_impl)
+    # Donate the input word buffers to the SPMD program where the
+    # backend profits (HBM aliasing) and the input can be rebuilt for
+    # overflow retries (a donated buffer is dead after the dispatch).
+    donate = _donation_enabled() and rebuild_words is not None
+    if donate and staged is not None:
+        # the first dispatch hands the staged buffers to XLA; flag the
+        # object now so a reuse fails with a clear error instead of
+        # dispatching on deleted arrays
+        staged.consumed = True
     cap = _round_cap(int(n / n_ranks * cap_factor) + 1, align)
     # Radix cap for skew reroutes: duplication that degenerates splitters
     # also concentrates a radix pass's send runs, so start at the same
@@ -1021,7 +1183,7 @@ def _sort_impl(
             tracer.counters["local_engine"] = spmd_engine
             while True:
                 fn = _compile_sample(mesh, codec.n_words, n, cap, oversample,
-                                     pack_impl, spmd_engine)
+                                     pack_impl, spmd_engine, donate)
                 with tracer.phase("sort"):
                     out, counts, max_cnt = _traced_call(
                         tracer, "sample_spmd", fn, *words,
@@ -1034,6 +1196,10 @@ def _sort_impl(
                 if max_cnt <= cap:
                     break
                 need = _round_cap(max_cnt, align)
+                if donate:
+                    # the dispatch consumed the input words; re-stage
+                    # before ANY rerun (retry here or radix reroute below)
+                    words = rebuild_words()
                 if need > cap_limit:
                     tracer.verbose(
                         f"sample exchange needs cap {max_cnt} > O(n) bound "
@@ -1057,7 +1223,11 @@ def _sort_impl(
 
     if res is None and algorithm == "radix":
         with tracer.phase("plan"):
-            if words_np is None:
+            if plan_diffs is not None:
+                # Streamed ingest already folded per-word max^min
+                # chunk-by-chunk — planning is free.
+                diffs = plan_diffs
+            elif words_np is None:
                 # Device-resident input: one scalar min/max sync per word
                 # plans the pass count (pads replicate the max key — range
                 # unchanged).
@@ -1070,7 +1240,7 @@ def _sort_impl(
             passes = _passes_from_diffs(diffs, digit_bits)
         while True:
             fn = _compile_radix(mesh, codec.n_words, n, digit_bits, cap, passes,
-                                pack_impl)
+                                pack_impl, donate)
             with tracer.phase("sort"):
                 out, max_cnt = _traced_call(
                     tracer, "radix_spmd", fn, *words,
@@ -1091,6 +1261,8 @@ def _sort_impl(
             tracer.verbose(f"radix exchange overflow (need {max_cnt} > cap {cap}); retrying")
             tracer.count("exchange_retries", 1)
             cap = _round_cap(max_cnt, align)
+            if donate:
+                words = rebuild_words()  # donated input died with the call
         tracer.count("exchange_passes", passes)
         tracer.counters["exchange_cap"] = cap  # last cap, not accumulated
         tracer.counters["digit_bits"] = digit_bits  # auto-resolved width
@@ -1100,5 +1272,5 @@ def _sort_impl(
     if return_result:
         return res
     with tracer.phase("decode"):
-        out_np = res.to_numpy()
+        out_np = res.to_numpy(tracer=tracer)
     return out_np

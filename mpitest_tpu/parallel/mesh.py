@@ -40,6 +40,30 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def shard_bounds(mesh: Mesh, n_per_shard: int) -> list[tuple]:
+    """Per-device ``(device, start, stop)`` over the global padded key
+    axis — device d owns ``[d*n, (d+1)*n)``, exactly the block split
+    :func:`key_sharding` produces.  This is the placement map the
+    streaming ingest pipeline (models/ingest.py) uses to route each
+    parsed chunk's slices to their owning devices: a chunk that spans a
+    boundary splits into per-device pieces, so the DMA of chunk k can
+    land while chunk k+1 is still being parsed/encoded."""
+    return [
+        (d, i * n_per_shard, (i + 1) * n_per_shard)
+        for i, d in enumerate(mesh.devices.flat)
+    ]
+
+
+def assemble_sharded(mesh: Mesh, per_device: list, total: int):
+    """Glue per-device single-device buffers (one per mesh device, in
+    mesh order, each already committed to its device) into ONE
+    key-axis-sharded global array — zero host copies, the closing step
+    of the streamed ingest.  The inverse view of :func:`shard_bounds`."""
+    return jax.make_array_from_single_device_arrays(
+        (total,), key_sharding(mesh), per_device
+    )
+
+
 def multihost_init(coordinator: str | None = None, num_processes: int | None = None,
                    process_id: int | None = None) -> None:
     """Multi-host runtime bring-up (v5e-16-and-beyond path).

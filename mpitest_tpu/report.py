@@ -40,7 +40,8 @@ import json
 import sys
 from pathlib import Path
 
-from mpitest_tpu.utils.spans import MPI_EQUIV, SCHEMA as SPAN_SCHEMA
+from mpitest_tpu.utils.spans import (MPI_EQUIV, SCHEMA as SPAN_SCHEMA,
+                                     merge_intervals, overlap_seconds)
 
 COMM_STATS_SCHEMA = "comm_stats.v1"
 
@@ -85,13 +86,27 @@ def load_rows(path: str) -> list[dict]:
 
 # ----------------------------------------------------------- aggregation
 
+#: Ingest/egress pipeline stages and which side of the host/device
+#: boundary each works.  Overlap is computed PER DIRECTION (the span
+#: name's prefix): ingest host work against ingest transfers, egress
+#: decode against egress fetches — pooling them would let egress-only
+#: overlap satisfy the --require-ingest-overlap gate after an ingest
+#: regression.  ``ingest.pipeline`` is the umbrella span and is
+#: excluded from per-stage sums (it would double-count its children).
+INGEST_HOST_STAGES = ("ingest.parse", "ingest.encode", "egress.decode")
+INGEST_XFER_STAGES = ("ingest.transfer", "egress.fetch")
+
+
 def aggregate(rows: list[dict]) -> dict:
     """Fold rows into the report tables.
 
     Returns ``{"phases": {name: {"ms", "count"}},
                "collectives": {source: {coll: {calls, bytes, seconds}}},
                "metrics": {metric: latest bench/metrics value row},
-               "spans": {name: count}}``.
+               "spans": {name: count},
+               "ingest": {stage: {seconds, count, bytes}},
+               "ingest_overlap"/"egress_overlap":
+                   {host_s, transfer_s, overlap_s, pct} | None}``.
     Collective sources are ``tpu`` (span events, mapped through
     MPI_EQUIV) and ``native/<backend>x<ranks>`` (comm_stats records).
     """
@@ -99,6 +114,14 @@ def aggregate(rows: list[dict]) -> dict:
     colls: dict[str, dict] = {}
     metrics: dict[str, dict] = {}
     span_counts: dict[str, int] = {}
+    ingest: dict[str, dict] = {}
+    # overlap intervals grouped per (file, pid): t0 is a process-relative
+    # perf_counter clock, so intervals from different runs appended to
+    # one SORT_TRACE file live on unrelated timelines — comparing them
+    # would manufacture phantom overlap (and green-light a serial
+    # pipeline through --require-ingest-overlap).
+    host_iv: dict[tuple, list] = {}
+    xfer_iv: dict[tuple, list] = {}
 
     def add_coll(source: str, name: str, calls, nbytes, seconds) -> None:
         row = colls.setdefault(source, {}).setdefault(
@@ -121,6 +144,18 @@ def aggregate(rows: list[dict]) -> dict:
                 add_coll("tpu", MPI_EQUIV[name], 1,
                          obj.get("attrs", {}).get("bytes", 0),
                          obj.get("dt", 0.0))
+            elif name in INGEST_HOST_STAGES or name in INGEST_XFER_STAGES:
+                row = ingest.setdefault(
+                    name, {"seconds": 0.0, "count": 0, "bytes": 0})
+                dt = float(obj.get("dt", 0.0))
+                t0 = float(obj.get("t0", 0.0))
+                row["seconds"] += dt
+                row["count"] += 1
+                row["bytes"] += int(obj.get("attrs", {}).get("bytes", 0))
+                run = (obj.get("_path"), obj.get("pid"),
+                       name.split(".", 1)[0])
+                (host_iv if name in INGEST_HOST_STAGES
+                 else xfer_iv).setdefault(run, []).append((t0, t0 + dt))
         elif kind == "comm_stats":
             source = f"native/{obj.get('backend', '?')}x{obj.get('ranks', '?')}"
             for cname, c in obj.get("collectives", {}).items():
@@ -140,8 +175,24 @@ def aggregate(rows: list[dict]) -> dict:
         elif kind == "bench":
             metrics[obj["metric"]] = {k: v for k, v in obj.items()
                                       if not k.startswith("_")}
+    def direction_overlap(direction: str) -> dict | None:
+        runs = {r for r in set(host_iv) | set(xfer_iv) if r[2] == direction}
+        if not runs:
+            return None
+        host_s = xfer_s = ov = 0.0
+        for run in runs:
+            hm = merge_intervals(host_iv.get(run, []))
+            xm = merge_intervals(xfer_iv.get(run, []))
+            host_s += sum(b - a for a, b in hm)
+            xfer_s += sum(b - a for a, b in xm)
+            ov += overlap_seconds(hm, xm)
+        return {"host_s": host_s, "transfer_s": xfer_s, "overlap_s": ov,
+                "pct": 100.0 * ov / xfer_s if xfer_s > 0 else 0.0}
+
     return {"phases": phases, "collectives": colls, "metrics": metrics,
-            "spans": span_counts}
+            "spans": span_counts, "ingest": ingest,
+            "ingest_overlap": direction_overlap("ingest"),
+            "egress_overlap": direction_overlap("egress")}
 
 
 # ------------------------------------------------------------ regression
@@ -261,6 +312,23 @@ def render(agg: dict) -> str:
                 out.append(
                     f"  {source:<18} {cname:<12} {c['calls']:>7} "
                     f"{_fmt_bytes(c['bytes']):>12} {c['seconds']:>11.6f}")
+    if agg.get("ingest"):
+        out.append("")
+        out.append("ingest/egress pipeline (streamed host↔device)")
+        out.append(f"  {'stage':<18} {'seconds':>11} {'count':>7} "
+                   f"{'bytes':>12} {'GB/s':>8}")
+        for name, r in sorted(agg["ingest"].items()):
+            gbs = (r["bytes"] / r["seconds"] / 1e9) if r["seconds"] else 0.0
+            out.append(f"  {name:<18} {r['seconds']:>11.6f} {r['count']:>7} "
+                       f"{_fmt_bytes(r['bytes']):>12} {gbs:>8.2f}")
+        for label, key in (("ingest parse/encode ∩ transfer",
+                            "ingest_overlap"),
+                           ("egress decode ∩ fetch", "egress_overlap")):
+            ov = agg.get(key)
+            if ov:
+                out.append(
+                    f"  {label} overlap: {ov['overlap_s']:.6f}s "
+                    f"({ov['pct']:.1f}% of {ov['transfer_s']:.6f}s transfer)")
     if agg["metrics"]:
         out.append("")
         out.append("metrics (latest row per name)")
@@ -287,6 +355,12 @@ def main(argv: list[str] | None = None) -> int:
                          " when present)")
     ap.add_argument("--check", action="store_true",
                     help="schema-validate the files; exit 1 on violations")
+    ap.add_argument("--require-ingest-overlap", action="store_true",
+                    help="exit 1 unless the ingest.* spans show nonzero "
+                         "parse/encode ∩ transfer overlap (the `make "
+                         "ingest-selftest` gate: proves the pipeline "
+                         "genuinely overlapped host work with DMA in "
+                         "this run; egress overlap does not count)")
     ap.add_argument("--baseline",
                     help="pinned baseline JSONL of bench rows; regressions "
                          "exit 2")
@@ -322,9 +396,19 @@ def main(argv: list[str] | None = None) -> int:
         print(f"telemetry check OK: {len(rows)} rows "
               f"({n_spans} spans, {n_stats} comm_stats) across "
               f"{len(files)} file(s)")
-        return 0
+        if not args.require_ingest_overlap:
+            return 0
 
     agg = aggregate(rows)
+    if args.require_ingest_overlap:
+        ov = agg["ingest_overlap"]
+        if not ov or ov["overlap_s"] <= 0:
+            print("[ERROR] ingest spans show NO parse/encode ∩ transfer "
+                  "overlap — the pipeline ran serially (or no ingest.* "
+                  "spans were emitted)", file=sys.stderr)
+            return 1
+        print(f"ingest overlap OK: {ov['overlap_s']:.6f}s "
+              f"({ov['pct']:.1f}% of transfer)")
     print(render(agg))
 
     if args.baseline:

@@ -7,9 +7,22 @@ one-int-at-a-time ``realloc`` loop (``mpi_sample_sort.c:41-60``,
 
 The reference ships no generators; the benchmark configs (BASELINE.json)
 need uniform and Zipf(1.1) key streams, so they live here.
+
+Streaming layer (ISSUE 2): the monolithic readers above materialize the
+whole array before anything else can start; :func:`open_keys_mmap`
+instead hands the ingest pipeline in :mod:`mpitest_tpu.models.ingest` a
+zero-copy view of a SORTBIN1 file whose fixed-size slices page in
+chunk-by-chunk, so parse, encode and host→device DMA overlap with
+bounded host memory.  Text files parse through the multi-threaded
+chunked block reader (:func:`iter_key_chunks`) but materialize once —
+the pipeline's shard bounds need the total key count up front.  The
+``SORT_INGEST_CHUNK`` / ``SORT_INGEST_THREADS`` knobs below are the one
+canonical reader for both the CLI and the library.
 """
 
 from __future__ import annotations
+
+import os
 
 import numpy as np
 
@@ -67,17 +80,33 @@ def read_keys_text(path: str, dtype=np.int32) -> np.ndarray:
     return arr.astype(dt)
 
 
-def write_keys_text(path: str, keys: np.ndarray) -> None:
+#: Keys per buffered block in write_keys_text: ~1 MiB of int32 text per
+#: write() call — hundreds of times fewer syscalls than the old
+#: np.savetxt row loop, constant memory at any key count.
+_WRITE_CHUNK_ELEMS = 1 << 16
+
+
+def write_keys_text(path: str, keys: np.ndarray,
+                    chunk_elems: int = _WRITE_CHUNK_ELEMS) -> None:
     """Write keys in the reference input format (one key per line).
     Floats print with shortest-guaranteed-round-trip precision (9 / 17
     significant digits for f32 / f64), so text round-trips bit-exactly
-    for finite values."""
+    for finite values.  Writes are buffered and chunked (``chunk_elems``
+    keys per block) — byte-identical output to the old per-row
+    ``np.savetxt`` loop at a fraction of the syscalls."""
     keys = np.asarray(keys).reshape(-1)
     if keys.dtype.kind == "f":
         fmt = "%.9g" if keys.dtype.itemsize == 4 else "%.17g"
     else:
         fmt = "%d"
-    np.savetxt(path, keys, fmt=fmt)
+    with open(path, "w", buffering=1 << 20) as f:
+        for i in range(0, keys.size, chunk_elems):
+            seg = keys[i:i + chunk_elems].tolist()
+            if fmt == "%d":
+                f.write("\n".join(map(str, seg)))
+            else:
+                f.write("\n".join(fmt % v for v in seg))
+            f.write("\n")
 
 
 def read_keys_binary(path: str, dtype=np.int32) -> np.ndarray:
@@ -96,6 +125,198 @@ def write_keys_binary(path: str, keys: np.ndarray) -> None:
     with open(path, "wb") as f:
         f.write(_bin_header(keys.dtype))
         keys.tofile(f)
+
+
+# --------------------------------------------------------------------------
+# Streaming ingest layer (ISSUE 2): env knobs, format sniff, chunked readers
+# --------------------------------------------------------------------------
+
+#: Default elements per streamed chunk: 2^22 keys = 16 MiB of int32 —
+#: large enough to amortize per-chunk dispatch, small enough that the
+#: double-buffered pipeline holds only tens of MiB of host memory and
+#: a 2^28 bench run pipelines across 64 chunks.
+DEFAULT_CHUNK_ELEMS = 1 << 22
+
+INGEST_MODES = ("auto", "stream", "mono")
+
+
+def ingest_mode() -> str:
+    """Ingest pipeline selector: ``SORT_INGEST`` ∈ {auto, stream, mono}.
+    ``auto`` (default) streams when the input is large enough for the
+    overlap to pay for the pipeline's thread machinery; ``stream``
+    forces the pipeline at any size (tests, the selftest); ``mono``
+    forces the legacy monolithic encode + one device_put."""
+    m = os.environ.get("SORT_INGEST", "auto")
+    if m not in INGEST_MODES:
+        raise ValueError(f"SORT_INGEST={m!r}; use one of {INGEST_MODES}")
+    return m
+
+
+def ingest_chunk_elems() -> int:
+    """Elements per streamed chunk (``SORT_INGEST_CHUNK``, default
+    :data:`DEFAULT_CHUNK_ELEMS`)."""
+    v = os.environ.get("SORT_INGEST_CHUNK")
+    if v is None:
+        return DEFAULT_CHUNK_ELEMS
+    try:
+        c = int(v)
+    except ValueError:
+        c = 0
+    if c < 1:
+        raise ValueError(f"SORT_INGEST_CHUNK={v!r}: use an integer >= 1")
+    return c
+
+
+def ingest_threads() -> int:
+    """Host parse/encode worker threads (``SORT_INGEST_THREADS``,
+    default 2 — one chunk encoding while another parses; the DMA issue
+    thread is separate and always single so transfers stay in order)."""
+    v = os.environ.get("SORT_INGEST_THREADS")
+    if v is None:
+        return 2
+    try:
+        t = int(v)
+    except ValueError:
+        t = 0
+    if t < 1:
+        raise ValueError(f"SORT_INGEST_THREADS={v!r}: use an integer >= 1")
+    return t
+
+
+DONATE_MODES = ("auto", "1", "0")
+
+
+def donate_setting() -> str:
+    """Validated ``SORT_DONATE`` value (auto/1/0) — the ONE definition
+    of the accepted set, shared by the CLI's fail-fast block and the
+    sort dispatch's resolver (models/api.py), which maps ``auto`` to
+    backend-dependent behavior."""
+    v = os.environ.get("SORT_DONATE", "auto")
+    if v not in DONATE_MODES:
+        raise ValueError(f"SORT_DONATE={v!r}: use 'auto', '1' or '0'")
+    return v
+
+
+def sniff_format(path: str) -> str:
+    """``"binary"`` (SORTBIN1 magic) or ``"text"`` — sniffed ONCE here so
+    no caller re-checks the magic (each reader used to)."""
+    with open(path, "rb") as f:
+        return "binary" if f.read(len(BIN_MAGIC)) == BIN_MAGIC else "text"
+
+
+def open_keys_mmap(path: str, dtype=np.int32) -> np.ndarray:
+    """SORTBIN1 file as an mmap-backed array (header checked, zero-copy):
+    slicing it costs nothing until the bytes are touched, which is what
+    lets the ingest pipeline's parse stage page keys in chunk-by-chunk
+    while earlier chunks are already encoding/transferring."""
+    dt = np.dtype(dtype)
+    with open(path, "rb") as f:
+        head = f.read(BIN_HEADER_LEN)
+        if head[:8] != BIN_MAGIC:
+            raise ValueError(f"'{path}' is not a SORTBIN1 key file")
+        _check_bin_header(head, path, dt)
+    return np.memmap(path, dtype=dt, mode="r", offset=BIN_HEADER_LEN)
+
+
+def _parse_text_block(block: bytes, dt: np.dtype) -> np.ndarray:
+    """One whitespace-delimited text block -> keys, same per-dtype
+    semantics as :func:`read_keys_text` (uint64 exact, floats through a
+    float64 parse then narrowed, ints via an int64 intermediate), but
+    C-speed: numpy casts the byte-token array directly."""
+    tokens = block.split()
+    if not tokens:
+        return np.empty(0, dt)
+    toks = np.array(tokens)
+    if dt == np.dtype(np.uint64):
+        return toks.astype(np.uint64)
+    if dt.kind == "f":
+        return toks.astype(np.float64).astype(dt)
+    return toks.astype(np.int64).astype(dt)
+
+
+#: Text-chunk byte budget per key: covers sign + 10 digits + newline for
+#: int32; wider dtypes just yield slightly larger chunks, which is fine
+#: (chunk size is a pipeline granularity, not a correctness parameter).
+_TEXT_BYTES_PER_KEY = 12
+
+
+def _iter_text_blocks(path: str, block_bytes: int):
+    """Whitespace-safe byte blocks: each block ends on a token boundary,
+    the partial trailing token carries into the next block — a chunk
+    boundary can never split a key."""
+    carry = b""
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(block_bytes)
+            if not block:
+                if carry.strip():
+                    yield carry
+                return
+            block = carry + block
+            cut = max(block.rfind(w) for w in (b" ", b"\n", b"\t", b"\r"))
+            if cut < 0:
+                carry = block  # one giant token so far; keep accreting
+                continue
+            carry = block[cut + 1:]
+            piece = block[: cut + 1]
+            if piece.strip():
+                yield piece
+
+
+def _iter_text_key_chunks(path: str, dt: np.dtype, chunk_elems: int,
+                          threads: int | None):
+    """Text half of :func:`iter_key_chunks`, post-sniff: blocks parsed
+    by a ``threads``-wide pool with bounded prefetch, so parsing chunk
+    k+1 overlaps whatever the consumer does with chunk k."""
+    from collections import deque
+    from concurrent.futures import ThreadPoolExecutor
+
+    threads = threads or ingest_threads()
+    blocks = _iter_text_blocks(path, chunk_elems * _TEXT_BYTES_PER_KEY)
+    with ThreadPoolExecutor(max_workers=threads) as ex:
+        pending = deque()
+        for b in blocks:
+            pending.append(ex.submit(_parse_text_block, b, dt))
+            while len(pending) > threads:
+                yield pending.popleft().result()
+        while pending:
+            yield pending.popleft().result()
+
+
+def iter_key_chunks(path: str, dtype=np.int32, chunk_elems: int | None = None,
+                    threads: int | None = None):
+    """Yield the file's keys as a sequence of arrays of (approximately)
+    ``chunk_elems`` keys, concatenation-equal to :func:`read_keys_auto`.
+
+    SORTBIN1 files yield mmap-backed zero-copy slices (exactly
+    ``chunk_elems`` long except the tail); text files parse through
+    :func:`_iter_text_key_chunks`.
+    """
+    dt = np.dtype(dtype)
+    chunk_elems = chunk_elems or ingest_chunk_elems()
+    if sniff_format(path) == "binary":
+        mm = open_keys_mmap(path, dt)
+        for i in range(0, mm.size, chunk_elems):
+            yield mm[i:i + chunk_elems]
+        return
+    yield from _iter_text_key_chunks(path, dt, chunk_elems, threads)
+
+
+def read_keys_auto(path: str, dtype=np.int32, mmap: bool = False) -> np.ndarray:
+    """Read keys, sniffing SORTBIN1 vs text ONCE (the sniff used to be
+    re-done by every caller, and the text branch dispatches straight to
+    the post-sniff iterator).  ``mmap=True`` returns the zero-copy
+    mmap-backed array for binary files (the streaming ingest path pages
+    it in chunk-by-chunk); text files parse through the multi-threaded
+    chunked reader.  Well-formed decimal tokens only — the same contract
+    :func:`read_keys_text` documents."""
+    dt = np.dtype(dtype)
+    if sniff_format(path) == "binary":
+        return open_keys_mmap(path, dt) if mmap else read_keys_binary(path, dt)
+    parts = list(_iter_text_key_chunks(path, dt, ingest_chunk_elems(), None))
+    if not parts:
+        return np.empty(0, dt)
+    return parts[0] if len(parts) == 1 else np.concatenate(parts)
 
 
 def generate_uniform(n: int, dtype=np.int32, seed: int = 0) -> np.ndarray:

@@ -28,14 +28,23 @@ A warm (cache-hit) jit call re-emits nothing; the report CLI aggregates
 per compiled program, exactly like ``COMM_STATS`` aggregates per native
 run.
 
-Thread model: one SpanLog per Tracer, single-threaded (the host driver
-is one process; native per-rank telemetry lives in the C backends).
+Thread model: one SpanLog per Tracer.  The *nesting* API (``span()`` /
+``event()``) remains single-threaded — only the host driver thread opens
+nested spans.  Pipeline worker threads (the streaming ingest/egress
+stages of :mod:`mpitest_tpu.models.ingest`, which measure their own
+parse/encode/DMA intervals with ``perf_counter``) report through the
+thread-safe :meth:`SpanLog.record` instead: it allocates ids, retains
+and streams under a lock, and parents the span under the innermost span
+the driver thread currently has open WITHOUT touching the nesting
+stack, so concurrent workers can never corrupt span nesting.
 """
 
 from __future__ import annotations
 
 import contextlib
 import json
+import os
+import threading
 import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
@@ -64,6 +73,38 @@ MPI_EQUIV = {
 }
 
 
+def merge_intervals(iv: list) -> list:
+    """Sorted, coalesced ``(t0, t1)`` intervals — shared by the report
+    CLI's overlap tables and the ingest pipeline's own stats, so both
+    compute 'host work ∩ transfer' identically."""
+    out: list = []
+    for a, b in sorted(iv):
+        if out and a <= out[-1][1]:
+            out[-1][1] = max(out[-1][1], b)
+        else:
+            out.append([a, b])
+    return [(a, b) for a, b in out]
+
+
+def overlap_seconds(a: list, b: list) -> float:
+    """Total intersection of two MERGED interval lists — the wall-clock
+    seconds the two activities genuinely ran concurrently.  Clocks are
+    process-relative ``perf_counter``, so this is only meaningful for
+    intervals from one process."""
+    i = j = 0
+    total = 0.0
+    while i < len(a) and j < len(b):
+        lo = max(a[i][0], b[j][0])
+        hi = min(a[i][1], b[j][1])
+        if hi > lo:
+            total += hi - lo
+        if a[i][1] < b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
 @dataclass
 class Span:
     """One event: a timed interval (``dt >= 0``) or a point event
@@ -77,10 +118,14 @@ class Span:
     attrs: dict = field(default_factory=dict)
 
     def to_dict(self) -> dict:
+        # pid scopes the process-relative perf_counter timeline: rows
+        # appended to one SORT_TRACE file by different runs must never
+        # be compared on t0 (report.py groups overlap math by it).
         return {
             "v": SCHEMA, "name": self.name, "id": self.id,
             "parent": self.parent, "t0": round(self.t0, 9),
-            "dt": round(self.dt, 9), "attrs": self.attrs,
+            "dt": round(self.dt, 9), "pid": os.getpid(),
+            "attrs": self.attrs,
         }
 
 
@@ -127,22 +172,41 @@ class SpanLog:
         self.dropped = 0       # spans past MAX_RETAINED_SPANS (streamed only)
         self._stack: list[int] = []
         self._next_id = 0
+        #: guards id allocation, retention and streaming — the pieces
+        #: pipeline worker threads share with the driver thread.  The
+        #: nesting stack stays driver-thread-only by contract.
+        self._lock = threading.Lock()
 
     # -- recording ----------------------------------------------------
-    def _new(self, name: str, attrs: dict) -> Span:
-        s = Span(
-            name=name, id=self._next_id,
-            parent=self._stack[-1] if self._stack else None,
-            t0=time.perf_counter(), attrs=attrs,
-        )
-        self._next_id += 1
+    def _new(self, name: str, attrs: dict, t0: float | None = None,
+             dt: float = 0.0) -> Span:
+        with self._lock:
+            s = Span(
+                name=name, id=self._next_id,
+                parent=self._stack[-1] if self._stack else None,
+                t0=time.perf_counter() if t0 is None else t0,
+                dt=dt, attrs=attrs,
+            )
+            self._next_id += 1
         return s
 
     def _retain(self, s: Span) -> None:
-        if len(self.spans) < MAX_RETAINED_SPANS:
-            self.spans.append(s)
-        else:
-            self.dropped += 1
+        with self._lock:
+            if len(self.spans) < MAX_RETAINED_SPANS:
+                self.spans.append(s)
+            else:
+                self.dropped += 1
+
+    def record(self, name: str, t0: float, dt: float, **attrs) -> Span:
+        """Thread-safe completed-span recording — the entry point for
+        pipeline worker threads (ingest/egress stages), which time their
+        own intervals and report them here after the fact.  Parents
+        under the driver thread's innermost open span; never touches
+        the nesting stack."""
+        s = self._new(name, attrs, t0=t0, dt=dt)
+        self._retain(s)
+        self._flush(s)
+        return s
 
     def event(self, name: str, **attrs) -> Span:
         """Point event (dt=0) under the currently open span."""
@@ -170,9 +234,13 @@ class SpanLog:
                 _ACTIVE.pop()
             self._flush(s)
 
+    #: serializes stream appends across threads (O_APPEND writes of one
+    #: line are atomic on Linux, but don't bet a JSONL schema on it).
+    _flush_lock = threading.Lock()
+
     def _flush(self, s: Span) -> None:
         if self.stream_path:
-            with open(self.stream_path, "a") as f:
+            with self._flush_lock, open(self.stream_path, "a") as f:
                 f.write(json.dumps(s.to_dict()) + "\n")
 
     # -- export -------------------------------------------------------

@@ -64,3 +64,105 @@ def test_uint64_text_exact(tmp_path):
 def test_missing_file():
     with pytest.raises(FileNotFoundError):
         io.read_keys_text("/nonexistent/file.txt")
+
+
+ALL_DTYPES = [np.int8, np.uint8, np.int16, np.uint16, np.int32, np.uint32,
+              np.int64, np.uint64, np.float32, np.float64]
+
+
+@pytest.mark.parametrize("dtype", ALL_DTYPES)
+def test_binary_roundtrip_all_dtypes(dtype, tmp_path):
+    """SORTBIN1 round-trips bit-exactly for EVERY supported key dtype
+    (ISSUE 2 satellite) — including NaN/±0.0 float payloads, which a
+    text round-trip can't always carry."""
+    x = io.generate("uniform", 257, dtype, seed=5)
+    if np.dtype(dtype).kind == "f":
+        x[:4] = [np.nan, -0.0, np.inf, -np.inf]
+    p = str(tmp_path / "keys.bin")
+    io.write_keys_binary(p, x)
+    back = io.read_keys_binary(p, dtype)
+    assert back.dtype == np.dtype(dtype)
+    np.testing.assert_array_equal(back.view(np.uint8), x.view(np.uint8))
+
+
+def test_read_keys_auto_sniffs_once(tmp_path):
+    """read_keys_auto dispatches on the SORTBIN1 magic for both formats,
+    and mmap=True returns a zero-copy mmap-backed array for binary."""
+    x = np.arange(-500, 500, dtype=np.int32)
+    pb = str(tmp_path / "k.bin")
+    pt = str(tmp_path / "k.txt")
+    io.write_keys_binary(pb, x)
+    io.write_keys_text(pt, x)
+    np.testing.assert_array_equal(io.read_keys_auto(pb), x)
+    np.testing.assert_array_equal(io.read_keys_auto(pt), x)
+    mm = io.read_keys_auto(pb, mmap=True)
+    assert isinstance(mm, np.memmap)
+    np.testing.assert_array_equal(np.asarray(mm), x)
+    # dtype mismatch is still a hard error through the auto path
+    with pytest.raises(ValueError):
+        io.read_keys_auto(pb, np.int64)
+    with pytest.raises(FileNotFoundError):
+        io.read_keys_auto(str(tmp_path / "absent.bin"))
+
+
+@pytest.mark.parametrize("dtype", [np.int32, np.uint64, np.float64])
+def test_chunked_reader_equivalence_text(dtype, tmp_path, rng):
+    """iter_key_chunks over a TEXT file concatenates to exactly the
+    monolithic read — with a chunk budget so small that block boundaries
+    land mid-token, exercising the carry logic."""
+    x = io.generate("uniform", 1000, dtype, seed=11)
+    p = str(tmp_path / "keys.txt")
+    io.write_keys_text(p, x)
+    ref = io.read_keys_text(p, dtype)
+    # chunk_elems=3 -> ~36-byte blocks: guaranteed to split tokens
+    chunks = list(io.iter_key_chunks(p, dtype, chunk_elems=3))
+    assert len(chunks) > 10
+    got = np.concatenate(chunks)
+    assert got.dtype == np.dtype(dtype)
+    np.testing.assert_array_equal(
+        got.view(np.uint8), ref.view(np.uint8))
+
+
+def test_chunked_reader_equivalence_binary(tmp_path, rng):
+    """Binary chunks are mmap-backed slices whose concatenation equals
+    the monolithic binary read, for divisible and non-divisible chunk
+    counts (incl. the 1-chunk case)."""
+    x = rng.integers(-(2**31), 2**31 - 1, size=1013, dtype=np.int32)
+    p = str(tmp_path / "keys.bin")
+    io.write_keys_binary(p, x)
+    for ce in (100, 1013, 5000):
+        chunks = list(io.iter_key_chunks(p, np.int32, chunk_elems=ce))
+        np.testing.assert_array_equal(np.concatenate(chunks), x)
+    assert all(isinstance(c.base, np.memmap) or isinstance(c, np.memmap)
+               for c in io.iter_key_chunks(p, np.int32, chunk_elems=100))
+
+
+@pytest.mark.parametrize("dtype", [np.int32, np.uint64, np.float32])
+def test_write_keys_text_chunked(dtype, tmp_path):
+    """Buffered chunked writes produce the same text (and the same
+    bit-exact round-trip) as a whole-array write."""
+    x = io.generate("uniform", 777, dtype, seed=2)
+    p1, p2 = str(tmp_path / "a.txt"), str(tmp_path / "b.txt")
+    io.write_keys_text(p1, x)                    # default chunking
+    io.write_keys_text(p2, x, chunk_elems=10)    # forced tiny chunks
+    assert open(p1).read() == open(p2).read()
+    back = io.read_keys_text(p1, dtype)
+    np.testing.assert_array_equal(back.view(np.uint8), x.view(np.uint8))
+
+
+def test_ingest_knob_validation(monkeypatch):
+    """The ingest env knobs fail fast with knob-naming messages."""
+    monkeypatch.setenv("SORT_INGEST", "sideways")
+    with pytest.raises(ValueError, match="SORT_INGEST="):
+        io.ingest_mode()
+    monkeypatch.delenv("SORT_INGEST")
+    assert io.ingest_mode() == "auto"
+    for knob, fn in (("SORT_INGEST_CHUNK", io.ingest_chunk_elems),
+                     ("SORT_INGEST_THREADS", io.ingest_threads)):
+        for bad in ("0", "-3", "garbage"):
+            monkeypatch.setenv(knob, bad)
+            with pytest.raises(ValueError, match=knob):
+                fn()
+        monkeypatch.setenv(knob, "7")
+        assert fn() == 7
+        monkeypatch.delenv(knob)

@@ -150,3 +150,100 @@ def test_main_aggregates_baseline_results_default(tmp_path, capsys,
     assert report.main([]) == 0
     out = capsys.readouterr().out
     assert BENCH_ROW["metric"] in out and "alltoallv" in out
+
+
+INGEST_ROWS = [
+    {"v": "span.v1", "name": "ingest.parse", "id": 10, "parent": None,
+     "t0": 0.0, "dt": 0.4, "attrs": {"chunk": 0, "bytes": 1000}},
+    {"v": "span.v1", "name": "ingest.encode", "id": 11, "parent": None,
+     "t0": 0.5, "dt": 0.4, "attrs": {"chunk": 0, "bytes": 1000}},
+    # transfer [0.7, 1.2) overlaps encode [0.5, 0.9) by 0.2s and the
+    # second parse [1.0, 1.3) by 0.2s -> 0.4s total host∩transfer
+    {"v": "span.v1", "name": "ingest.transfer", "id": 12, "parent": None,
+     "t0": 0.7, "dt": 0.5, "attrs": {"chunk": 0, "bytes": 1000}},
+    {"v": "span.v1", "name": "ingest.parse", "id": 13, "parent": None,
+     "t0": 1.0, "dt": 0.3, "attrs": {"chunk": 1, "bytes": 500}},
+]
+
+
+def test_ingest_overlap_aggregation(tmp_path):
+    """ISSUE 2: the ingest table sums per-stage seconds/bytes and the
+    overlap row measures host-stage ∩ transfer wall-clock concurrency
+    from span intervals."""
+    p = write_jsonl(tmp_path / "ingest.jsonl", INGEST_ROWS)
+    agg = report.aggregate(report.load_rows(p))
+    assert agg["ingest"]["ingest.parse"]["count"] == 2
+    assert agg["ingest"]["ingest.parse"]["seconds"] == pytest.approx(0.7)
+    assert agg["ingest"]["ingest.parse"]["bytes"] == 1500
+    ov = agg["ingest_overlap"]
+    assert ov["overlap_s"] == pytest.approx(0.4)
+    assert ov["transfer_s"] == pytest.approx(0.5)
+    assert ov["pct"] == pytest.approx(80.0)
+    rendered = report.render(agg)
+    assert "ingest/egress pipeline" in rendered
+    assert "overlap" in rendered
+
+
+def test_main_require_ingest_overlap_exit_codes(tmp_path, capsys):
+    """--require-ingest-overlap: 0 with genuine overlap, 1 when the
+    stages ran serially (or no ingest spans exist)."""
+    good = write_jsonl(tmp_path / "good.jsonl", INGEST_ROWS)
+    assert report.main(["--check", "--require-ingest-overlap", good]) == 0
+    out = capsys.readouterr().out
+    assert "ingest overlap OK" in out
+    serial = [dict(r) for r in INGEST_ROWS]
+    for i, r in enumerate(serial):  # push every span onto its own second
+        r = dict(r)
+        r["t0"] = float(10 * i)
+        serial[i] = r
+    bad = write_jsonl(tmp_path / "serial.jsonl", serial)
+    assert report.main(["--check", "--require-ingest-overlap", bad]) == 1
+    assert "NO parse/encode" in capsys.readouterr().err
+
+
+def test_require_ingest_overlap_ignores_egress(tmp_path, capsys):
+    """Egress-only overlap must NOT satisfy the ingest gate: a change
+    that serializes stream_to_mesh has to fail `make ingest-selftest`
+    even while the egress side still overlaps."""
+    rows = [  # serial ingest...
+        {"v": "span.v1", "name": "ingest.parse", "id": 1, "parent": None,
+         "t0": 0.0, "dt": 0.3, "pid": 7, "attrs": {"bytes": 10}},
+        {"v": "span.v1", "name": "ingest.transfer", "id": 2, "parent": None,
+         "t0": 0.4, "dt": 0.3, "pid": 7, "attrs": {"bytes": 10}},
+        # ...but genuinely overlapped egress
+        {"v": "span.v1", "name": "egress.fetch", "id": 3, "parent": None,
+         "t0": 1.0, "dt": 0.4, "pid": 7, "attrs": {"bytes": 10}},
+        {"v": "span.v1", "name": "egress.decode", "id": 4, "parent": None,
+         "t0": 1.2, "dt": 0.4, "pid": 7, "attrs": {"bytes": 10}},
+    ]
+    p = write_jsonl(tmp_path / "egress_only.jsonl", rows)
+    agg = report.aggregate(report.load_rows(p))
+    assert agg["ingest_overlap"]["overlap_s"] == 0.0
+    assert agg["egress_overlap"]["overlap_s"] == pytest.approx(0.2)
+    assert report.main(["--require-ingest-overlap", p]) == 1
+    assert "NO parse/encode" in capsys.readouterr().err
+
+
+def test_ingest_overlap_groups_runs_by_pid(tmp_path):
+    """Two serial runs appended to ONE trace file must not manufacture
+    overlap: t0 is a process-relative perf_counter clock, so run A's
+    host spans and run B's transfers live on unrelated timelines.  The
+    aggregator groups intervals per (file, pid)."""
+    run_a = [  # fully serial pipeline: parse then transfer, no overlap
+        {"v": "span.v1", "name": "ingest.parse", "id": 1, "parent": None,
+         "t0": 0.0, "dt": 0.4, "pid": 100, "attrs": {"bytes": 10}},
+        {"v": "span.v1", "name": "ingest.transfer", "id": 2, "parent": None,
+         "t0": 0.5, "dt": 0.4, "pid": 100, "attrs": {"bytes": 10}},
+    ]
+    run_b = [  # second run, also serial, clock restarted near zero
+        {"v": "span.v1", "name": "ingest.parse", "id": 1, "parent": None,
+         "t0": 0.45, "dt": 0.4, "pid": 200, "attrs": {"bytes": 10}},
+        {"v": "span.v1", "name": "ingest.transfer", "id": 2, "parent": None,
+         "t0": 0.9, "dt": 0.4, "pid": 200, "attrs": {"bytes": 10}},
+    ]
+    p = write_jsonl(tmp_path / "two_runs.jsonl", run_a + run_b)
+    ov = report.aggregate(report.load_rows(p))["ingest_overlap"]
+    # cross-run phantom overlap (A.transfer [0.5,0.9) ∩ B.parse
+    # [0.45,0.85)) must NOT count — both runs were serial
+    assert ov["overlap_s"] == 0.0
+    assert ov["transfer_s"] == pytest.approx(0.8)

@@ -13,6 +13,14 @@
 #                  layer: a tiny TPU-path sort with SORT_TRACE (span
 #                  JSONL) + a native run with COMM_STATS, both validated
 #                  by `python -m mpitest_tpu.report --check`
+#   make fault-selftest — chaos-test matrix (ISSUE 3): the full
+#                  SORT_FAULTS grid (8 fault sites x {sample, radix}),
+#                  persistent-fault ladder cells, the CLI's typed exit
+#                  codes, and the native COMM_FAULTS kill/stall drills.
+#                  Every cell must recover with a fingerprint-verified
+#                  result or fail loudly with a nonzero exit — zero
+#                  silent-wrong-answer cells; warm verifier overhead is
+#                  asserted < 5% of sort wall.
 #   make ingest-selftest — end-to-end check of the streaming ingest
 #                  pipeline: a SORTBIN1 sort forced through the chunked
 #                  pipeline under SORT_TRACE; `report.py --check
@@ -23,7 +31,8 @@
 
 PYTHON ?= python3
 
-.PHONY: test native chip-test telemetry-selftest ingest-selftest clean
+.PHONY: test native chip-test telemetry-selftest ingest-selftest \
+    fault-selftest clean
 
 chip-test:
 	$(PYTHON) -u bench/chip_regression.py
@@ -58,6 +67,15 @@ telemetry-selftest:
 	    $(TELEMETRY_TMP)/trace.jsonl $(TELEMETRY_TMP)/comm_stats.jsonl
 	$(PYTHON) -m mpitest_tpu.report \
 	    $(TELEMETRY_TMP)/trace.jsonl $(TELEMETRY_TMP)/comm_stats.jsonl
+
+# The chaos matrix (ISSUE 3 acceptance gate) — see bench/fault_selftest.py.
+# Builds the native binaries the COMM_FAULTS drills target first.
+fault-selftest:
+	$(MAKE) -C mpi_radix_sort BACKEND=local
+	$(MAKE) -C bench radix_sort_minimpi
+	JAX_PLATFORMS=cpu \
+	    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	    $(PYTHON) -u bench/fault_selftest.py
 
 # Proof the streamed ingest pipeline is live and actually overlapping:
 # a 2^22-key SORTBIN1 file (mmap-sliced into 16 chunks) sorted on a

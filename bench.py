@@ -175,10 +175,21 @@ def main() -> None:
         # float64 2^18 bench produced a wrong sort via a float32 shadow).
         jax.config.update("jax_enable_x64", True)
 
-    from mpitest_tpu.models.api import checked_device_put, ingest_to_mesh, sort
+    from mpitest_tpu.models.api import (SortRetryExhausted,
+                                        checked_device_put, ingest_to_mesh,
+                                        sort)
     from mpitest_tpu.parallel.mesh import key_sharding, make_mesh
     from mpitest_tpu.utils.metrics import Metrics
     from mpitest_tpu.utils.trace import Tracer
+
+    # The bench measures the DEVICE path: graceful degradation to a host
+    # sort or retry backoff sleeps would silently rewrite the metric, so
+    # the supervisor is pinned fail-fast here (the chaos grid — `make
+    # fault-selftest` — is where recovery is exercised).  Verification
+    # stays ON by default: its cost is part of the honest number and is
+    # reported below as verify_overhead_s.
+    os.environ.setdefault("SORT_FALLBACK", "0")
+    os.environ.setdefault("SORT_MAX_RETRIES", "0")
 
     platform = jax.devices()[0].platform
     on_tpu = platform not in ("cpu",)
@@ -258,11 +269,14 @@ def main() -> None:
                 w.block_until_ready()
             # block_until_ready is advisory on the axon tunnel; force a sync.
             jax.device_get(r.words[0][-1:])
-        except jax.errors.JaxRuntimeError as e:
+        except (jax.errors.JaxRuntimeError, SortRetryExhausted) as e:
             # Near the HBM limit (2^30 = 4 GB keys on a 16 GB chip) the
             # previous run's buffers may not have deallocated yet; keep
             # whatever repeats completed rather than losing the result.
-            if "RESOURCE_EXHAUSTED" not in str(e) or not times:
+            # (With SORT_MAX_RETRIES=0 the supervisor surfaces the OOM
+            # as SortRetryExhausted with the real error as __cause__.)
+            cause = f"{e} {getattr(e, '__cause__', None) or ''}"
+            if "RESOURCE_EXHAUSTED" not in cause or not times:
                 raise
             log(f"run {i}: skipped (HBM exhausted; keeping {len(times)} runs)")
             break
@@ -337,10 +351,11 @@ def main() -> None:
             # staging ran under different memory/cache conditions
             ing = staged.stats
             ingest_s = ing.wall_s
-    except jax.errors.JaxRuntimeError as e:
+    except (jax.errors.JaxRuntimeError, SortRetryExhausted) as e:
         # the second staging doubles resident key bytes next to x_dev —
         # near the HBM limit it may OOM; keep the already-measured row.
-        if "RESOURCE_EXHAUSTED" not in str(e):
+        cause = f"{e} {getattr(e, '__cause__', None) or ''}"
+        if "RESOURCE_EXHAUSTED" not in cause:
             raise
         log("ingest-inclusive run: skipped (HBM exhausted)")
         incl_s = None
@@ -361,6 +376,22 @@ def main() -> None:
     metrics.record("ingest_chunks", ing.chunks)
     if incl_s is not None:
         metrics.throughput("sort_incl_ingest_mkeys_per_s", n, incl_s)
+    # Robustness cost accounting (ISSUE 3): retries actually paid,
+    # faults injected (nonzero only under SORT_FAULTS drills), and the
+    # wall seconds the always-on verifier added to the LAST timed run —
+    # so BENCH JSONs track what robustness costs, not just that it
+    # exists.  The acceptance budget is verifier overhead < 5% of sort
+    # wall time.
+    retries = int(tracer.counters.get("exchange_retries", 0)
+                  + tracer.counters.get("sort_retries", 0))
+    faults_injected = int(tracer.counters.get("faults_injected", 0))
+    verify_s = round(tracer.phases.get("verify", 0.0), 6)
+    if verify_s:
+        log(f"verifier overhead: {verify_s:.4f}s = "
+            f"{100.0 * verify_s / best:.2f}% of best sort wall")
+    metrics.record("retries", retries)
+    metrics.record("faults_injected", faults_injected)
+    metrics.record("verify_overhead_s", verify_s, "s")
     metrics.record_tracer(tracer)  # last run's tracer: per-run values
     metrics.dump()  # structured sidecar → stderr
 
@@ -377,6 +408,9 @@ def main() -> None:
         "baseline": (f"native_{native_ranks}rank" if vs_native is not None
                      else "np_sort"),
         "vs_np_sort": round(mkeys / np_mkeys, 3),
+        "retries": retries,
+        "faults_injected": faults_injected,
+        "verify_overhead_s": verify_s,
     }
     if vs_canonical is not None:
         out["vs_canonical_native"] = round(vs_canonical, 3)

@@ -1,0 +1,222 @@
+"""Chaos-test matrix: the `make fault-selftest` gate (ISSUE 3).
+
+Runs the full fault-spec grid — every :data:`mpitest_tpu.faults.SITES`
+entry x {sample, radix}, plus persistent-failure and fallback-disabled
+variants, the CLI exit-code contract, and the native COMM_FAULTS
+kill/stall drills — and asserts the ONE property the robustness layer
+exists for:
+
+    every cell either recovers with a fingerprint-verified, bit-exact
+    result, or fails loudly with a typed error / nonzero exit.
+    ZERO silent-wrong-answer cells.
+
+A cell where a fault was injected but the output came back wrong and
+undetected is a hard failure of this gate — that is the reference's
+silent-overflow behavior reborn, the exact bug class this repo's port
+eliminated.
+
+Runs TPU-free on the virtual 8-device CPU mesh (like the rest of CI);
+wall time is dominated by one-time XLA compiles, a couple of minutes.
+Also cross-checks the verifier-overhead budget: the accumulated warm
+verify phase must stay under 5% of warm sort wall (the bench row's
+``verify_overhead_s`` tracks the same quantity at scale).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+os.environ.setdefault("SORT_RETRY_BACKOFF", "0")  # drills, not prod: no sleeps
+
+from mpitest_tpu.utils.platform import ensure_virtual_cpu_devices  # noqa: E402
+
+ensure_virtual_cpu_devices(8)
+
+import numpy as np  # noqa: E402
+
+from mpitest_tpu import faults  # noqa: E402
+from mpitest_tpu.models.api import (  # noqa: E402
+    SortIntegrityError, SortRetryExhausted, sort)
+from mpitest_tpu.parallel.mesh import make_mesh  # noqa: E402
+from mpitest_tpu.utils.trace import Tracer  # noqa: E402
+
+PASS, FAIL = "recovered", "FAILED"
+results: list[tuple[str, str, str]] = []   # (cell, outcome, detail)
+bad = 0
+
+
+def cell(name: str, outcome_ok: bool, detail: str) -> None:
+    global bad
+    results.append((name, PASS if outcome_ok else FAIL, detail))
+    if not outcome_ok:
+        bad += 1
+    print(f"  {'ok ' if outcome_ok else 'BAD'} {name:<42} {detail}",
+          flush=True)
+
+
+def main() -> int:
+    mesh = make_mesh(8)
+    rng = np.random.default_rng(42)
+    x = rng.integers(-(2**31), 2**31 - 1, size=30_000, dtype=np.int32)
+    ref = np.sort(x)
+
+    print("fault grid: 8 sites x {radix, sample} — must recover verified")
+    for site in faults.SITES:
+        for algo in ("radix", "sample"):
+            env_extra = {}
+            if site == "ingest_poison":
+                # the poison hook lives in the streamed ingest pipeline
+                env_extra = {"SORT_INGEST": "stream",
+                             "SORT_INGEST_CHUNK": "4096"}
+            old = {k: os.environ.get(k) for k in env_extra}
+            os.environ.update(env_extra)
+            reg = faults.FaultRegistry(site, seed=7)
+            faults.install(reg)
+            tr = Tracer()
+            try:
+                got = sort(x, algorithm=algo, mesh=mesh, tracer=tr)
+                exact = bool(np.array_equal(got, ref))
+                fired = reg.injected > 0
+                detail = (f"faults={reg.injected} "
+                          f"retries={int(tr.counters.get('sort_retries', 0) + tr.counters.get('exchange_retries', 0))} "
+                          f"verify_failures={int(tr.counters.get('verify_failures', 0))}")
+                cell(f"{site} x {algo}", exact and fired,
+                     detail + ("" if exact else " WRONG RESULT")
+                     + ("" if fired else " FAULT NEVER FIRED"))
+            except (SortIntegrityError, SortRetryExhausted) as e:
+                # loud, typed failure is an acceptable outcome — but for
+                # single transient faults the ladder should recover
+                cell(f"{site} x {algo}", False,
+                     f"typed error on a transient fault: {type(e).__name__}")
+            finally:
+                faults.install(None)
+                for k, v in old.items():
+                    os.environ.pop(k, None) if v is None else \
+                        os.environ.__setitem__(k, v)
+
+    print("persistent faults: recover via ladder OR fail typed")
+    for spec, fallback, expect in (
+        ("dispatch_oom:inf", "1", "host"),        # degrade to host sort
+        ("dispatch_oom:inf", "0", "retryerr"),    # typed retry exhaustion
+        ("result_dup:inf", "0", "integrityerr"),  # typed integrity error
+    ):
+        for algo in ("radix", "sample"):
+            os.environ["SORT_FALLBACK"] = fallback
+            reg = faults.FaultRegistry(spec, seed=7)
+            faults.install(reg)
+            tr = Tracer()
+            name = f"{spec} fallback={fallback} x {algo}"
+            try:
+                got = sort(x, algorithm=algo, mesh=mesh, tracer=tr)
+                ok = (expect == "host"
+                      and np.array_equal(got, ref)
+                      and tr.counters.get("degraded_to") == "host")
+                cell(name, ok, f"degraded_to={tr.counters.get('degraded_to')}"
+                     + ("" if np.array_equal(got, ref) else " WRONG RESULT"))
+            except SortRetryExhausted:
+                cell(name, expect == "retryerr", "SortRetryExhausted")
+            except SortIntegrityError:
+                cell(name, expect == "integrityerr", "SortIntegrityError")
+            finally:
+                faults.install(None)
+                os.environ.pop("SORT_FALLBACK", None)
+
+    print("CLI exit codes: typed errors -> distinct nonzero exits")
+    keyfile = "/tmp/fault_selftest_keys.txt"
+    with open(keyfile, "w") as f:
+        f.write("\n".join(str(v) for v in x[:5000]) + "\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               SORT_RETRY_BACKOFF="0")
+    for spec, fallback, want_rc in (
+        ("result_dup:inf", "0", 3),   # EXIT_INTEGRITY
+        ("dispatch_oom:inf", "0", 4),  # EXIT_RETRIES
+        ("garbage_site", "1", 1),      # knob validation
+    ):
+        r = subprocess.run(
+            [sys.executable, str(REPO / "drivers" / "sort_cli.py"), keyfile],
+            capture_output=True, text=True, timeout=600,
+            env=dict(env, SORT_FAULTS=spec, SORT_FALLBACK=fallback))
+        one_line_err = (r.stderr.count("[ERROR]") == 1
+                        and "Traceback" not in r.stderr)
+        cell(f"cli SORT_FAULTS={spec}", r.returncode == want_rc
+             and one_line_err,
+             f"rc={r.returncode} (want {want_rc})")
+    r = subprocess.run(
+        [sys.executable, str(REPO / "drivers" / "sort_cli.py"), keyfile],
+        capture_output=True, text=True, timeout=600,
+        env=dict(env, SORT_FAULTS="exchange_corrupt"))
+    cell("cli SORT_FAULTS=exchange_corrupt recovers",
+         r.returncode == 0 and "n/2-th sorted element" in r.stdout,
+         f"rc={r.returncode}")
+
+    print("native COMM_FAULTS drills (pthreads + minimpi)")
+    radix_bin = REPO / "mpi_radix_sort" / "radix_sort"
+    mini_bin = REPO / "bench" / "radix_sort_minimpi"
+    keys_native = "/tmp/fault_selftest_native.txt"
+    with open(keys_native, "w") as f:
+        f.write("\n".join(str(v) for v in x[:20_000]) + "\n")
+    median = int(np.sort(x[:20_000])[10_000 - 1])
+    for label, binary, env_ranks in (
+        ("local", radix_bin, {"COMM_RANKS": "4"}),
+        ("minimpi", mini_bin, {"MINIMPI_NP": "4"}),
+    ):
+        if not binary.exists():
+            cell(f"native {label}", False, f"{binary} not built")
+            continue
+        r = subprocess.run(
+            [str(binary), keys_native], capture_output=True, text=True,
+            timeout=60, env=dict(os.environ, **env_ranks,
+                                 COMM_FAULTS="kill:1@3"))
+        cell(f"COMM_FAULTS=kill x {label}",
+             r.returncode != 0 and "[FAULT]" in r.stderr,
+             f"rc={r.returncode} (nonzero + loud = pass)")
+        r = subprocess.run(
+            [str(binary), keys_native], capture_output=True, text=True,
+            timeout=120, env=dict(os.environ, **env_ranks,
+                                  COMM_FAULTS="stall:2@2:50"))
+        cell(f"COMM_FAULTS=stall x {label}",
+             r.returncode == 0
+             and f"The n/2-th sorted element: {median}" in r.stdout,
+             f"rc={r.returncode}")
+
+    # verifier overhead budget on WARM programs (compiles amortized
+    # out), measured at a size where per-dispatch latency no longer
+    # dominates (tiny inputs mismeasure fixed dispatch cost as
+    # "overhead"); best-of-3 to shed scheduler noise.  The acceptance
+    # bound is < 5% of sort wall; bench.py reports the same quantity at
+    # benchmark scale as verify_overhead_s.
+    xv = rng.integers(-(2**31), 2**31 - 1, size=1 << 22, dtype=np.int32)
+    sort(xv, algorithm="radix", mesh=mesh)         # warm the programs
+    ratios = []
+    for _ in range(4):
+        tr = Tracer()
+        t0 = time.perf_counter()
+        sort(xv, algorithm="radix", mesh=mesh, tracer=tr)
+        wall = time.perf_counter() - t0
+        v = tr.phases.get("verify", 0.0)
+        ratios.append((100.0 * v / wall if wall else 0.0, v, wall))
+    # min ratio over runs: the least-noise estimate of the INTRINSIC
+    # overhead — scheduler hiccups on this 1-core box inflate single
+    # runs by several x, in either phase.
+    pct, v, wall = min(ratios)
+    print(f"verifier overhead (warm, 2^22, min of {len(ratios)}): "
+          f"{v:.4f}s of {wall:.4f}s = {pct:.2f}%  "
+          f"(all: {', '.join(f'{r:.2f}%' for r, _, _ in ratios)})")
+    cell("verifier overhead < 5%", pct < 5.0, f"{pct:.2f}%")
+
+    n_pass = sum(1 for _, o, _ in results if o == PASS)
+    print(f"\nfault-selftest: {n_pass}/{len(results)} cells clean "
+          f"({bad} failing)")
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

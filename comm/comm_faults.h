@@ -1,0 +1,144 @@
+/* comm_faults.h — deterministic fault injection for the native comm
+ * backends: the C mirror of the Python SORT_FAULTS registry
+ * (mpitest_tpu/faults.py), aimed at the failure class the reference
+ * made catastrophic — a rank that stalls or dies mid-protocol strands
+ * every peer in a collective forever (native/minimpi_earlyexit.c,
+ * SURVEY §7.4).
+ *
+ * COMM_FAULTS=<spec>, a comma list of:
+ *
+ *   kill:<rank>@<nth>         rank <rank> dies (exit COMM_FAULT_EXIT)
+ *                             entering its <nth> collective call
+ *   stall:<rank>@<nth>:<ms>   rank <rank> sleeps <ms> milliseconds
+ *                             entering its <nth> collective call
+ *
+ * Counting is per rank and 1-based over that rank's own collective
+ * entries (barrier included), so a spec is deterministic for a given
+ * program + input — same property as the Python registry's seeded
+ * counts.
+ *
+ * What the spec must PROVE per backend:
+ *   - comm_local (pthreads): ranks share one process — a "killed" rank
+ *     takes the process down loudly ([FAULT] line + nonzero exit), the
+ *     only honest semantic for shared memory (a silently-exited thread
+ *     would strand its peers in pthread_barrier_wait forever, which is
+ *     exactly the reference's hang reborn).
+ *   - comm_mpi over minimpi: the killed rank is a real process; the
+ *     minimpi supervisor must reap it and bring the whole job down
+ *     with the fault code instead of hanging — the mpirun contract the
+ *     early-exit fix established, now exercised mid-collective.
+ *   - stall on either backend: peers WAIT (barriers are blocking, not
+ *     timing out) and the run completes with byte-identical output —
+ *     slowness is not data loss.
+ *
+ * Header-only, zero overhead when COMM_FAULTS is unset (one getenv at
+ * launch, one n==0 branch per collective).
+ */
+#ifndef COMM_FAULTS_H
+#define COMM_FAULTS_H
+
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+#include <unistd.h>
+
+/* Distinct from the sort programs' 1 (usage/file) and the sanitizers'
+ * codes, so tests can assert the death was the injected fault. */
+#define COMM_FAULT_EXIT 43
+
+enum { COMM_FAULT_NONE = 0, COMM_FAULT_KILL = 1, COMM_FAULT_STALL = 2 };
+
+typedef struct {
+    int kind;      /* COMM_FAULT_KILL | COMM_FAULT_STALL */
+    int rank;      /* which rank the fault targets */
+    long nth;      /* 1-based collective-entry count on that rank */
+    long ms;       /* stall duration (STALL only) */
+} comm_fault_spec_t;
+
+#define COMM_FAULTS_MAX 8
+
+typedef struct {
+    int n;                                   /* 0 = injection off */
+    comm_fault_spec_t f[COMM_FAULTS_MAX];
+} comm_faults_t;
+
+/* Parse the COMM_FAULTS env (NULL/"" = off).  Returns 0 on success,
+ * -1 on a malformed spec (callers must fail the launch loudly — a
+ * typo'd drill that silently runs clean would report false health). */
+static inline int comm_faults_parse(const char *env, comm_faults_t *out) {
+    memset(out, 0, sizeof *out);
+    if (!env || !*env)
+        return 0;
+    char buf[256];
+    snprintf(buf, sizeof buf, "%s", env);
+    char *save = NULL;
+    for (char *tok = strtok_r(buf, ",", &save); tok;
+         tok = strtok_r(NULL, ",", &save)) {
+        if (out->n >= COMM_FAULTS_MAX) {
+            fprintf(stderr, "COMM_FAULTS: more than %d entries\n",
+                    COMM_FAULTS_MAX);
+            return -1;
+        }
+        comm_fault_spec_t *f = &out->f[out->n];
+        int rank;
+        long nth, ms;
+        /* %n + full-token check: bare sscanf ignores trailing junk, so
+         * "kill:1@3:50" (a mistyped stall) would silently run a KILL —
+         * a typo'd drill executing the wrong fault is exactly the
+         * false-health outcome the -1 path exists to prevent. */
+        int used = -1;
+        if (sscanf(tok, "kill:%d@%ld%n", &rank, &nth, &used) == 2 &&
+            used >= 0 && tok[used] == '\0') {
+            f->kind = COMM_FAULT_KILL;
+            f->rank = rank;
+            f->nth = nth;
+        } else if ((used = -1,
+                    sscanf(tok, "stall:%d@%ld:%ld%n", &rank, &nth, &ms,
+                           &used) == 3) &&
+                   used >= 0 && tok[used] == '\0') {
+            f->kind = COMM_FAULT_STALL;
+            f->rank = rank;
+            f->nth = nth;
+            f->ms = ms;
+        } else {
+            fprintf(stderr, "COMM_FAULTS: bad entry '%s' (use "
+                            "kill:<rank>@<nth> or stall:<rank>@<nth>:<ms>)\n",
+                    tok);
+            return -1;
+        }
+        if (f->rank < 0 || f->nth < 1 ||
+            (f->kind == COMM_FAULT_STALL && f->ms < 0)) {
+            fprintf(stderr, "COMM_FAULTS: out-of-range values in '%s'\n", tok);
+            return -1;
+        }
+        out->n++;
+    }
+    return 0;
+}
+
+/* Collective-entry hook: bump this rank's counter and apply any
+ * matching fault.  KILL never returns. */
+static inline void comm_faults_enter(const comm_faults_t *cf, int rank,
+                                     unsigned long long *counter) {
+    if (cf->n == 0)
+        return;
+    unsigned long long call = ++*counter;
+    for (int i = 0; i < cf->n; i++) {
+        const comm_fault_spec_t *f = &cf->f[i];
+        if (f->rank != rank || (unsigned long long)f->nth != call)
+            continue;
+        if (f->kind == COMM_FAULT_KILL) {
+            fprintf(stderr, "[FAULT] rank %d killed entering collective "
+                            "#%llu (COMM_FAULTS)\n", rank, call);
+            fflush(NULL);
+            _exit(COMM_FAULT_EXIT);
+        }
+        fprintf(stderr, "[FAULT] rank %d stalling %ld ms at collective "
+                        "#%llu (COMM_FAULTS)\n", rank, f->ms, call);
+        struct timespec ts = {f->ms / 1000, (f->ms % 1000) * 1000000L};
+        nanosleep(&ts, NULL);
+    }
+}
+
+#endif /* COMM_FAULTS_H */

@@ -18,6 +18,7 @@
  */
 #define _GNU_SOURCE
 #include "comm.h"
+#include "comm_faults.h"
 #include "comm_stats.h"
 
 #include <pthread.h>
@@ -40,6 +41,10 @@ typedef struct world {
      * lock-free by its owner thread, folded + dumped by the launcher.
      * NULL when COMM_STATS is unset — collectives then pay one branch. */
     comm_stat_t (*stats)[COMM_ST_N];
+    /* COMM_FAULTS injection (comm_faults.h): parsed spec + one
+     * collective-entry counter per rank (owner-thread only). */
+    comm_faults_t faults;
+    unsigned long long *fault_calls;         /* [nranks] */
 } world_t;
 
 struct comm_ctx {
@@ -74,8 +79,12 @@ void comm_abort(comm_ctx *c, int code, const char *msg) {
  * counting it would bill every collective as two extra barriers. */
 static void bar(comm_ctx *c) { pthread_barrier_wait(&c->w->bar); }
 
-/* Telemetry shims: t0 sentinel < 0 means stats off (no clock calls). */
+/* Telemetry shims: t0 sentinel < 0 means stats off (no clock calls).
+ * Every collective enters through here, so this is also the ONE
+ * COMM_FAULTS injection point (kill/stall at the rank's nth collective
+ * — comm_faults.h; a no-op branch when the env is unset). */
 static double st_begin(const comm_ctx *c) {
+    comm_faults_enter(&c->w->faults, c->rank, &c->w->fault_calls[c->rank]);
     return c->w->stats ? comm_stats_now() : -1.0;
 }
 
@@ -288,7 +297,13 @@ int comm_launch(void (*fn)(comm_ctx *, void *), void *arg) {
         ? (comm_stat_t (*)[COMM_ST_N])calloc((size_t)nranks,
                                              sizeof(*w.stats))
         : NULL;
-    if (!w.slots || (stats_path && !w.stats)
+    /* COMM_FAULTS: a malformed drill spec fails the launch loudly — a
+     * typo that silently ran clean would report false health. */
+    if (comm_faults_parse(getenv("COMM_FAULTS"), &w.faults) != 0)
+        return 1;
+    w.fault_calls = (unsigned long long *)calloc((size_t)nranks,
+                                                 sizeof(unsigned long long));
+    if (!w.slots || !w.fault_calls || (stats_path && !w.stats)
         || pthread_barrier_init(&w.bar, NULL, (unsigned)nranks)) {
         fprintf(stderr, "comm_local: init failed\n");
         return 1;
@@ -316,5 +331,6 @@ int comm_launch(void (*fn)(comm_ctx *, void *), void *arg) {
     free(tids);
     free(tas);
     free(w.slots);
+    free(w.fault_calls);
     return 0;
 }

@@ -15,6 +15,7 @@
  */
 #include "comm_stats.h"   /* first: defines the POSIX feature macro */
 #include "comm.h"
+#include "comm_faults.h"
 
 #include <mpi.h>
 #include <limits.h>
@@ -50,7 +51,21 @@ void comm_abort(comm_ctx *c, int code, const char *msg) {
 static comm_stat_t g_stats[COMM_ST_N];
 static int g_stats_on;
 
-static double st_begin(void) { return g_stats_on ? MPI_Wtime() : -1.0; }
+/* COMM_FAULTS injection (comm_faults.h): one rank per process, so the
+ * spec + per-rank collective counter are file-static; comm_launch
+ * parses after MPI_Init (the rank is needed).  Over the minimpi
+ * runtime a killed rank is a real child process — the supervisor must
+ * bring the job down, mpirun-style, instead of hanging. */
+static comm_faults_t g_faults;
+static unsigned long long g_fault_calls;
+static int g_fault_rank;
+
+/* Every collective enters through st_begin — the one injection point,
+ * mirroring comm_local.c. */
+static double st_begin(void) {
+    comm_faults_enter(&g_faults, g_fault_rank, &g_fault_calls);
+    return g_stats_on ? MPI_Wtime() : -1.0;
+}
 
 static void st_end(int which, size_t bytes, double t0) {
     if (t0 >= 0.0)
@@ -201,6 +216,9 @@ int comm_launch(void (*fn)(comm_ctx *, void *), void *arg) {
     comm_ctx ctx;
     MPI_Comm_rank(MPI_COMM_WORLD, &ctx.rank);
     MPI_Comm_size(MPI_COMM_WORLD, &ctx.size);
+    g_fault_rank = ctx.rank;
+    if (comm_faults_parse(getenv("COMM_FAULTS"), &g_faults) != 0)
+        MPI_Abort(MPI_COMM_WORLD, 1); /* bad drill spec: fail loudly */
     const char *stats_path = comm_stats_path();
     g_stats_on = stats_path != NULL;
     fn(&ctx, arg);

@@ -46,6 +46,23 @@ mpitest_tpu.report`` alongside the native backends' ``COMM_STATS``
 records; ``SORT_TRACE_CHROME=<path>`` writes the same run as Chrome
 trace-event JSON (opens in Perfetto); ``SORT_PROFILE=<logdir>`` wraps
 the sort in a ``jax.profiler`` trace for TensorBoard.
+
+Robustness (ISSUE 3 — the supervised, self-verifying sort): every run
+is verified (on-device sortedness + multiset fingerprint against the
+input, ``SORT_VERIFY={1,0}``), dispatch retries transient failures
+(``SORT_MAX_RETRIES``, ``SORT_RETRY_BACKOFF``) and degrades gracefully
+(``SORT_FALLBACK={1,0}``: other algorithm, then host sort).  Fault
+injection for drills: ``SORT_FAULTS=<spec>`` / ``SORT_FAULTS_SEED``
+(``mpitest_tpu/faults.py``).  Terminal failures map to DISTINCT exit
+codes so wrappers can tell data corruption from infrastructure death:
+
+* exit :data:`EXIT_INTEGRITY` (3) — ``SortIntegrityError``: no path
+  produced a result that passes verification;
+* exit :data:`EXIT_RETRIES` (4) — ``SortRetryExhausted``: dispatch kept
+  failing past the retry budget (and fallback was off or failed too).
+
+Both print one ``[ERROR]`` line to stderr — never a traceback — the
+same fail-fast contract as the env-knob validation.
 """
 
 from __future__ import annotations
@@ -60,6 +77,11 @@ import numpy as np
 # Script-invocation bootstrap: the repo root (not drivers/) holds the
 # package, and this image cannot `pip install -e .` (see verify skill).
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+#: Distinct terminal exit codes (see module docstring).  1 stays the
+#: usage/knob/file-error code, matching the reference binaries.
+EXIT_INTEGRITY = 3
+EXIT_RETRIES = 4
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -161,6 +183,22 @@ def main(argv: list[str] | None = None) -> int:
     except ValueError as e:
         knob_error(str(e))
         return 1
+    # Robustness knobs (SORT_VERIFY / SORT_MAX_RETRIES /
+    # SORT_RETRY_BACKOFF / SORT_FALLBACK / SORT_FAULTS[_SEED]): same
+    # fail-fast contract — a garbage fault spec must die here, not
+    # mid-sort.
+    try:
+        from mpitest_tpu import faults as flt
+        from mpitest_tpu.models import supervisor as sup
+
+        sup.verify_enabled()
+        sup.max_retries()
+        sup.retry_backoff()
+        sup.fallback_enabled()
+        flt.validate_env()
+    except ValueError as e:
+        knob_error(str(e))
+        return 1
 
     try:
         # One magic sniff; SORTBIN1 opens as an mmap so the streaming
@@ -193,16 +231,31 @@ def main(argv: list[str] | None = None) -> int:
         # ceil(N/P): the reference's size_bucket line (mpi_sample_sort.c:74).
         print(f"Each bucket will be put {-(-n // n_ranks)} items.")
 
+    from mpitest_tpu.models.supervisor import (SortIntegrityError,
+                                               SortRetryExhausted)
+
     start = time.perf_counter()  # after file read, like MPI_Wtime at :61
-    with jax_profile(os.environ.get("SORT_PROFILE")):
-        res = sort(
-            keys, algorithm=algo, mesh=mesh, digit_bits=digit_bits,
-            cap_factor=cap_factor, oversample=oversample,
-            tracer=tracer, return_result=True,
-        )
-        # materialize = the reference's final Gatherv (streamed egress
-        # above the auto threshold: decode overlaps the shard fetches)
-        out = res.to_numpy(tracer=tracer)
+    try:
+        with jax_profile(os.environ.get("SORT_PROFILE")):
+            res = sort(
+                keys, algorithm=algo, mesh=mesh, digit_bits=digit_bits,
+                cap_factor=cap_factor, oversample=oversample,
+                tracer=tracer, return_result=True,
+            )
+            # materialize = the reference's final Gatherv (streamed egress
+            # above the auto threshold: decode overlaps the shard fetches)
+            out = res.to_numpy(tracer=tracer)
+    except SortIntegrityError as e:
+        # Data-integrity terminal: the result could not be verified and
+        # every recovery rung failed — distinct exit code so callers can
+        # quarantine the input/run, never trust partial output.
+        knob_error(f"sort integrity failure: {e}")
+        return EXIT_INTEGRITY
+    except SortRetryExhausted as e:
+        # Infrastructure terminal: dispatch kept dying past the retry
+        # budget — distinct code so schedulers can retry elsewhere.
+        knob_error(f"sort failed after retries: {e}")
+        return EXIT_RETRIES
     end = time.perf_counter()
 
     chrome_path = os.environ.get("SORT_TRACE_CHROME")

@@ -26,9 +26,17 @@ Layer map (mirrors SURVEY.md §7):
   tracing/debug-log contract, metrics.
 """
 
-from mpitest_tpu.models.api import sort, DistributedSortResult  # noqa: F401
+from mpitest_tpu.models.api import (  # noqa: F401
+    DistributedSortResult,
+    SortFaultError,
+    SortIntegrityError,
+    SortRetryExhausted,
+    sort,
+)
 from mpitest_tpu.parallel.mesh import make_mesh  # noqa: F401
 
 __version__ = "0.1.0"
 
-__all__ = ["sort", "DistributedSortResult", "make_mesh", "__version__"]
+__all__ = ["sort", "DistributedSortResult", "make_mesh",
+           "SortFaultError", "SortIntegrityError", "SortRetryExhausted",
+           "__version__"]

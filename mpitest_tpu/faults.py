@@ -1,0 +1,360 @@
+"""Deterministic fault injection — the chaos half of the robustness layer.
+
+The reference programs fail *silently*: bucket overflow truncates data
+(``mpi_sample_sort.c:140-144``) and a rank that exits early strands its
+peers (SURVEY §7.4).  Our port fixed those two instances, but a fix that
+is only exercised by the bug it patched proves nothing about the next
+fault.  This module makes failure a first-class, reproducible input:
+
+* ``SORT_FAULTS=<spec>`` arms a :class:`FaultRegistry` — a comma list of
+  ``site[:count]`` entries (``count`` defaults to 1; ``inf`` = fire on
+  every opportunity, the persistent-failure configuration).  The spec is
+  consumed deterministically: the k-th opportunity at a site fires iff
+  the site still has budget, and corruption values derive from a
+  splitmix64 stream over ``SORT_FAULTS_SEED`` — same spec + seed = the
+  same faults in the same places, every run.
+* Each subsystem polls its own site at its own fault point (the
+  supervisor at dispatch, the exchange between all_to_all and the local
+  sort/merge, the ingest pipeline after the fingerprint fold, the result
+  before verification), so every detection/recovery path in
+  ``models/supervisor.py`` is reachable from an env var.
+* The native backends mirror this with ``COMM_FAULTS``
+  (``comm/comm_faults.h``): ``kill:<rank>@<nth>`` / ``stall:<rank>@<nth>:<ms>``
+  at collective entry.
+
+Sites (the chaos grid of ``make fault-selftest`` covers all of them for
+both algorithms):
+
+================  ==========================================================
+``dispatch_error``  raise a transient ``JaxRuntimeError`` at SPMD dispatch
+``dispatch_oom``    raise a ``RESOURCE_EXHAUSTED``-shaped error at dispatch
+``exchange_corrupt`` XOR-corrupt one exchange lane between the
+                    all_to_all and the local sort (in-program, trace-time)
+``exchange_drop``   zero one peer's recv count — drop a whole segment
+``cap_squeeze``     force the first exchange cap to the alignment minimum
+``ingest_poison``   corrupt an encoded ingest chunk AFTER the input
+                    fingerprint folded it (streamed ingest only)
+``result_swap``     swap the first/last keys of the sorted result
+                    (breaks sortedness — caught by the order check)
+``result_dup``      overwrite key[1] with key[0] (stays sorted — caught
+                    ONLY by the multiset fingerprint)
+================  ==========================================================
+
+Injection never bypasses detection: faults corrupt *data*, and the
+always-on verifier (``models/verify.py``) plus the supervisor decide
+what the user sees — a retried, fingerprint-verified result or a typed
+error with a nonzero exit.  A fault that the system silently absorbs
+into a wrong answer is exactly the bug class this module exists to make
+impossible to miss.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+import os
+import threading
+from dataclasses import dataclass, field
+
+SITES = (
+    "dispatch_error",
+    "dispatch_oom",
+    "exchange_corrupt",
+    "exchange_drop",
+    "cap_squeeze",
+    "ingest_poison",
+    "result_swap",
+    "result_dup",
+)
+
+#: Sites applied at trace time inside the compiled SPMD program (the
+#: exchange faults) — arming one forces a fresh compile via a unique
+#: ``fault_token`` so the poisoned trace can never be served from the
+#: jit cache to a clean run.
+EXCHANGE_SITES = ("exchange_corrupt", "exchange_drop")
+
+
+def _splitmix64(state: int) -> tuple[int, int]:
+    """One splitmix64 step: (next_state, output) — the same deterministic
+    stream family native/comm_fuzz.c uses, so corruption values are
+    reproducible from SORT_FAULTS_SEED alone."""
+    state = (state + 0x9E3779B97F4A7C15) & 0xFFFFFFFFFFFFFFFF
+    z = state
+    z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
+    z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF
+    return state, z ^ (z >> 31)
+
+
+@dataclass
+class _Site:
+    name: str
+    remaining: float  # math.inf = persistent
+
+
+@dataclass
+class FaultRegistry:
+    """Parsed, seedable fault plan for ONE sort run.
+
+    ``fire(site)`` consumes one unit of that site's budget (thread-safe:
+    the ingest pool's workers poll ``ingest_poison`` concurrently) and
+    records the firing in :attr:`fired`; ``on_fire`` (set by the
+    supervisor) forwards each firing into the span/counter pipeline.
+    """
+
+    spec: str
+    seed: int = 0
+    sites: dict = field(default_factory=dict)
+    fired: list = field(default_factory=list)
+    on_fire: object = None  # callable(site, detail) | None
+
+    def __post_init__(self):
+        self._lock = threading.Lock()
+        self._rng_state = (self.seed * 0x2545F4914F6CDD1D + 1) & 0xFFFFFFFFFFFFFFFF
+        self._seq = 0
+        for entry in self.spec.split(","):
+            entry = entry.strip()
+            if not entry:
+                continue
+            name, _, cnt = entry.partition(":")
+            if name not in SITES:
+                raise ValueError(
+                    f"SORT_FAULTS: unknown fault site {name!r}; "
+                    f"use one of {SITES}"
+                )
+            if cnt in ("", None):
+                count: float = 1
+            elif cnt == "inf":
+                count = math.inf
+            else:
+                try:
+                    count = int(cnt)
+                except ValueError:
+                    count = 0
+                if count < 1:
+                    raise ValueError(
+                        f"SORT_FAULTS: bad count {cnt!r} for {name!r}; "
+                        "use a positive integer or 'inf'"
+                    )
+            site = self.sites.setdefault(name, _Site(name, 0))
+            site.remaining += count
+
+    # -- firing -------------------------------------------------------
+    def would_fire(self, site: str) -> bool:
+        """Non-consuming budget peek (lets hooks avoid advancing the
+        corruption RNG for sites that are not armed — the RNG stream
+        must depend only on the faults that actually fire)."""
+        with self._lock:
+            s = self.sites.get(site)
+            return s is not None and s.remaining > 0
+
+    def fire(self, site: str, **detail) -> bool:
+        """Consume one unit of ``site``'s budget; True iff the fault
+        fires now.  Records the firing and notifies ``on_fire``."""
+        with self._lock:
+            s = self.sites.get(site)
+            if s is None or s.remaining <= 0:
+                return False
+            s.remaining -= 1
+            self._seq += 1
+            detail = dict(detail, seq=self._seq)
+            self.fired.append((site, detail))
+        cb = self.on_fire
+        if cb is not None:
+            cb(site, detail)
+        return True
+
+    def rand_word(self) -> int:
+        """Deterministic nonzero uint32 corruption value."""
+        with self._lock:
+            self._rng_state, out = _splitmix64(self._rng_state)
+        return (out & 0xFFFFFFFF) or 0xDEADBEEF
+
+    @property
+    def injected(self) -> int:
+        return len(self.fired)
+
+
+# -- run-scoped activation -------------------------------------------------
+
+#: Registry installed explicitly (tests / the chaos driver) — takes
+#: precedence over the SORT_FAULTS env spec.
+_INSTALLED: FaultRegistry | None = None
+
+#: Stack of registries active for the current run (sort() / ingest
+#: pipeline); trace-time and worker-thread hooks read the top.
+_ACTIVE: list[FaultRegistry] = []
+
+#: Exchange fault handed from the host (supervisor) to the trace-time
+#: hook in collectives.ragged_all_to_all; one-shot, popped at trace.
+#: The supervisor drops it if the armed dispatch dies before tracing,
+#: and run teardown (``active.__exit__``) clears any stragglers — a
+#: stale entry must never leak into a later clean compile.
+_PENDING_EXCHANGE: list[dict] = []
+
+#: Process-global token sequence: every armed exchange fault gets a
+#: token no earlier compile can have used, so the jit cache can never
+#: serve a poisoned trace to a different run (or skip the trace that
+#: would consume the pending entry).
+_TOKEN_SEQ = itertools.count(1)
+
+
+def install(reg: FaultRegistry | None) -> None:
+    """Install a registry for subsequent runs (None clears).  Tests use
+    this instead of mutating os.environ."""
+    global _INSTALLED
+    _INSTALLED = reg
+
+
+def for_run() -> FaultRegistry | None:
+    """The registry for a new run: the installed one, else a FRESH parse
+    of ``SORT_FAULTS`` (counts reset every run — deterministic per run,
+    not cumulative across a process)."""
+    if _INSTALLED is not None:
+        return _INSTALLED
+    spec = os.environ.get("SORT_FAULTS")
+    if not spec:
+        return None
+    return FaultRegistry(spec, seed=faults_seed())
+
+
+def faults_seed() -> int:
+    v = os.environ.get("SORT_FAULTS_SEED", "0")
+    try:
+        return int(v)
+    except ValueError:
+        raise ValueError(f"SORT_FAULTS_SEED={v!r}: use an integer") from None
+
+
+def validate_env() -> None:
+    """Fail-fast parse of the fault knobs (the CLI's [ERROR] contract)."""
+    spec = os.environ.get("SORT_FAULTS")
+    if spec:
+        FaultRegistry(spec, seed=faults_seed())
+
+
+class active:
+    """Context manager scoping ``reg`` to the current run (re-entrant:
+    a donated-retry re-ingest inside a sort nests cleanly)."""
+
+    def __init__(self, reg: FaultRegistry | None):
+        self.reg = reg
+
+    def __enter__(self):
+        if self.reg is not None:
+            _ACTIVE.append(self.reg)
+        return self.reg
+
+    def __exit__(self, *exc):
+        if self.reg is not None and _ACTIVE and _ACTIVE[-1] is self.reg:
+            _ACTIVE.pop()
+        if self.reg is not None and not _ACTIVE:
+            drop_pending()  # no armed-but-untraced fault may outlive a run
+        return False
+
+
+def current() -> FaultRegistry | None:
+    return _ACTIVE[-1] if _ACTIVE else None
+
+
+# -- site hooks ------------------------------------------------------------
+
+def arm_exchange(reg: FaultRegistry | None) -> str:
+    """Host side of the exchange faults: if one fires for this dispatch,
+    queue its parameters for the trace-time hook and return a
+    PROCESS-UNIQUE compile token (forces a fresh trace — a reused token
+    would let the jit cache serve an old poisoned program AND leave the
+    pending entry unconsumed, to be baked into the next clean trace).
+    Empty token = clean compile, shared cache."""
+    if reg is None:
+        return ""
+    for site in EXCHANGE_SITES:
+        if not reg.would_fire(site):
+            continue  # don't advance the RNG for unarmed sites
+        word = reg.rand_word()
+        if reg.fire(site, word=word):
+            _PENDING_EXCHANGE.append({"site": site, "word": word})
+            return f"{site}#{next(_TOKEN_SEQ)}"
+    return ""
+
+
+def drop_pending() -> int:
+    """Discard any armed-but-unconsumed exchange fault — called when the
+    armed dispatch dies before its first trace and at run teardown, so a
+    stale entry can never corrupt a later clean compile.  Returns the
+    number dropped (the caller records them as ``faults_dropped`` —
+    they were counted as injected when armed but never touched data)."""
+    n = len(_PENDING_EXCHANGE)
+    _PENDING_EXCHANGE.clear()
+    return n
+
+
+def apply_exchange_fault(recv_arrays, recv_cnt):
+    """Trace-time hook (called from collectives.ragged_all_to_all, i.e.
+    between the exchange and the local sort/merge): apply the pending
+    exchange fault, if any, to the first traced exchange of the armed
+    dispatch.  No-op on clean compiles."""
+    if not _PENDING_EXCHANGE:
+        return recv_arrays, recv_cnt
+    import jax.numpy as jnp
+
+    spec = _PENDING_EXCHANGE.pop()
+    if spec["site"] == "exchange_drop":
+        # drop the segment peer 0 sent to every rank: a truncated
+        # exchange, the reference's silent-overflow shape
+        recv_cnt = recv_cnt.at[0].set(0)
+        return recv_arrays, recv_cnt
+    # exchange_corrupt: flip deterministic bits in lane (0, 0) of the
+    # first word array — a payload corrupted in flight
+    w0 = recv_arrays[0]
+    w0 = w0.at[0, 0].set(w0[0, 0] ^ jnp.uint32(spec["word"]))
+    return (w0,) + tuple(recv_arrays[1:]), recv_cnt
+
+
+def maybe_poison_chunk(words, chunk_idx: int):
+    """Ingest-pipeline hook (worker threads): corrupt CHUNK 0's first
+    encoded word AFTER the fingerprint fold — the device receives data
+    the fingerprint never saw, so the output verifier must flag it.
+    Pinned to chunk 0 (one budget unit per stream pass): encode workers
+    race on the budget otherwise, and which chunk got poisoned would
+    depend on thread scheduling — the registry's same-spec+seed
+    determinism contract forbids that."""
+    if chunk_idx != 0:
+        return words
+    reg = current()
+    if reg is None or not reg.would_fire("ingest_poison"):
+        return words
+    word = reg.rand_word()
+    if not reg.fire("ingest_poison", chunk=chunk_idx, word=word):
+        return words
+    w0 = words[0].copy()
+    if w0.size:
+        w0[0] ^= word & 0xFFFFFFFF
+    return (w0,) + tuple(words[1:])
+
+
+def maybe_corrupt_result(reg: FaultRegistry | None, res):
+    """Result hook (host side, before verification): swap endpoints
+    (breaks sortedness) or duplicate a key (multiset change only — the
+    fingerprint's job).  Returns a corrupted copy of ``res``'s words."""
+    if reg is None:
+        return res
+    import jax
+    import numpy as np
+
+    for site in ("result_swap", "result_dup"):
+        if reg.sites.get(site) and reg.sites[site].remaining > 0:
+            if not reg.fire(site):
+                continue
+            new_words = []
+            for w in res.words:
+                host = np.asarray(w).copy()
+                if host.size >= 2:
+                    if site == "result_swap":
+                        a, b = 0, min(res.n_valid, host.size) - 1
+                        host[a], host[b] = host[b].copy(), host[a].copy()
+                    else:
+                        host[1] = host[0]
+                new_words.append(jax.device_put(host, w.sharding))
+            res.words = tuple(new_words)
+            break
+    return res

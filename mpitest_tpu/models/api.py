@@ -39,8 +39,17 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-from mpitest_tpu import compat
+from mpitest_tpu import compat, faults
 from mpitest_tpu.models import radix_sort, sample_sort
+from mpitest_tpu.models import supervisor as supervision
+from mpitest_tpu.models import verify as vfy
+from mpitest_tpu.models.supervisor import (  # re-exported: public errors
+    ExchangeCapExceeded,
+    SortFaultError,
+    SortIntegrityError,
+    SortRetryExhausted,
+    SortSupervisor,
+)
 from mpitest_tpu.models.ingest import (
     EGRESS_MIN_BYTES as _EGRESS_MIN_BYTES,
     StagedIngest,
@@ -394,7 +403,8 @@ def _compile_pair_fused(dtype_name: str, impl: str):
     return jax.jit(f)
 
 
-def _local_pair_sort(x, is_device, codec, dtype, mesh, tracer):
+def _local_pair_sort(x, is_device, codec, dtype, mesh, tracer,
+                     words_np=None):
     """Single-device 64-bit sort orchestration — the MSD-hybrid structure
     (VERDICT r3 #1), adaptive like the skew fallback:
 
@@ -441,7 +451,10 @@ def _local_pair_sort(x, is_device, codec, dtype, mesh, tracer):
             return (hi_s, lo_s)
     if not is_device:
         with tracer.phase("encode"):
-            words_np = codec.encode(np.asarray(x).reshape(-1))
+            # caller may have encoded already (the verification
+            # fingerprint needs the words too — don't pay O(n) twice)
+            if words_np is None:
+                words_np = codec.encode(np.asarray(x).reshape(-1))
             rng = np.array([words_np[0].min(), words_np[0].max(),
                             words_np[1].min(), words_np[1].max()])
             dup = _host_hi_dup_sniff(words_np[0])
@@ -581,7 +594,11 @@ def _compile_local(n_words: int, engine: str = "auto"):
 
 @lru_cache(maxsize=64)
 def _compile_radix(mesh: Mesh, n_words: int, n: int, digit_bits: int, cap: int,
-                   passes: int, pack: str, donate: bool = False):
+                   passes: int, pack: str, donate: bool = False,
+                   fault_token: str = ""):
+    # fault_token: unique per armed exchange fault (mpitest_tpu.faults) —
+    # a poisoned trace gets its own cache entry and can never be served
+    # to a clean dispatch.  "" = the shared clean compile.
     n_ranks = mesh.devices.size
 
     def f(*words):
@@ -611,7 +628,9 @@ def _compile_radix(mesh: Mesh, n_words: int, n: int, digit_bits: int, cap: int,
 
 @lru_cache(maxsize=64)
 def _compile_sample(mesh: Mesh, n_words: int, n: int, cap: int, oversample: int,
-                    pack: str, engine: str = "lax", donate: bool = False):
+                    pack: str, engine: str = "lax", donate: bool = False,
+                    fault_token: str = ""):
+    # fault_token: see _compile_radix.
     n_ranks = mesh.devices.size
 
     def f(*words):
@@ -855,8 +874,11 @@ def ingest_to_mesh(
     trace_path = os.environ.get("SORT_TRACE")
     if trace_path and tracer.spans.stream_path is None:
         tracer.spans.stream_path = trace_path
+    reg = faults.for_run()
+    supervision.wire_registry(reg, tracer)
     with tracer.spans.span("ingest", n=int(np.asarray(x).size),
-                           dtype=str(np.asarray(x).dtype)):
+                           dtype=str(np.asarray(x).dtype)), \
+            faults.active(reg):
         return stream_to_mesh(x, mesh, tracer=tracer,
                               chunk_elems=chunk_elems, threads=threads)
 
@@ -898,13 +920,17 @@ def sort(
     if trace_path and tracer.spans.stream_path is None:
         tracer.spans.stream_path = trace_path
     size = getattr(x, "size", None)
+    # Fault registry for THIS run (SORT_FAULTS env or an installed test
+    # registry) — active for the whole run so the ingest/exchange hooks
+    # see it; None in production is a no-op.
+    reg = faults.for_run()
     with tracer.spans.span(
         "sort", algorithm=algorithm,
         n=int(size) if size is not None else None,
         dtype=str(getattr(x, "dtype", "")) or None,
-    ) as sp:
+    ) as sp, faults.active(reg):
         out = _sort_impl(x, algorithm, mesh, digit_bits, cap_factor,
-                         oversample, tracer, return_result, pack)
+                         oversample, tracer, return_result, pack, reg)
         _device_mem_high_water(sp, mesh)
     return out
 
@@ -919,9 +945,24 @@ def _sort_impl(
     tracer: Tracer,
     return_result: bool,
     pack: str | None,
+    reg: "faults.FaultRegistry | None" = None,
 ):
     """The sort() body (see the public wrapper's docstring — this layer
     assumes a validated algorithm and a live tracer/span log).
+
+    Robustness contract (ISSUE 3): every result is verified before it is
+    returned — on-device sortedness plus a multiset fingerprint compared
+    against the input-side fingerprint folded during ingest/encode
+    (:mod:`mpitest_tpu.models.verify`) — and the distributed dispatch
+    runs under a :class:`SortSupervisor`: bounded retry with exponential
+    backoff on transient ``JaxRuntimeError``, ONE shared cap-regrow loop
+    for both algorithms, and a graceful-degradation ladder (requested
+    algorithm → the other algorithm → host lexsort) on persistent
+    failure.  The outcome is always a verified result or a typed
+    :class:`SortIntegrityError` / :class:`SortRetryExhausted` — never a
+    silent wrong answer.  Knobs: ``SORT_VERIFY``, ``SORT_MAX_RETRIES``,
+    ``SORT_RETRY_BACKOFF``, ``SORT_FALLBACK``, ``SORT_FAULTS`` (fault
+    injection, :mod:`mpitest_tpu.faults`).
 
     ``algorithm``: ``"radix"`` (flagship: perfectly load-balanced, fixed
     pass count) or ``"sample"`` (one exchange round; cap-sensitive under
@@ -983,6 +1024,52 @@ def _sort_impl(
     n_ranks = int(mesh.devices.size)
     n = max(1, math.ceil(N / n_ranks))
 
+    verify_on = supervision.verify_enabled()
+    # Wire fault telemetry BEFORE any word staging: the ingest_poison
+    # site fires inside the streaming pipeline, long before the
+    # supervisor object exists below.
+    supervision.wire_registry(reg, tracer)
+
+    def _check_result(res_v, fp_v) -> bool:
+        """Run the on-device verifier on a result; True = verified.
+        Emits the ``verify`` span event (ok / sorted_ok / fp_ok) the
+        report CLI's robustness table aggregates."""
+        with tracer.phase("verify"):
+            sorted_ok, fp_ok = vfy.verify_result(res_v, fp_v)
+        tracer.count("verify_runs", 1)
+        tracer.spans.event("verify", ok=bool(sorted_ok and fp_ok),
+                           sorted_ok=bool(sorted_ok), fp_ok=bool(fp_ok),
+                           n=N)
+        if not (sorted_ok and fp_ok):
+            tracer.verbose(
+                f"output verification FAILED (sorted={bool(sorted_ok)}, "
+                f"fingerprint={bool(fp_ok)})")
+        return bool(sorted_ok and fp_ok)
+
+    def _local_device_fp():
+        """Input fingerprint for device-resident single-device input:
+        one tiny fused encode+reduce dispatch.  The known f64 encode
+        lowering gap degrades to sortedness-only verification (fp None)
+        rather than breaking the sort."""
+        try:
+            return vfy.fingerprint_device_input(x.reshape(-1), dtype)
+        except jax.errors.JaxRuntimeError:
+            tracer.verbose("input fingerprint unavailable on this backend; "
+                           "verifying sortedness only")
+            return None
+
+    def _finish_local(res_l, fp_l):
+        """Verify-and-return for the single-device paths.  No ladder
+        here (the degradation machinery targets the distributed
+        dispatch); a verification failure is a typed error."""
+        if verify_on and not _check_result(res_l, fp_l):
+            raise SortIntegrityError(
+                "single-device sort result failed verification")
+        if return_result:
+            return res_l
+        with tracer.phase("decode"):
+            return res_l.to_numpy(tracer=tracer)
+
     if staged is not None and n_ranks == 1:
         # 1-device mesh with pre-staged words: one fused local sort of
         # the padded shard (pads replicate the max key, so they sort to
@@ -991,11 +1078,8 @@ def _sort_impl(
             out = _traced_call(
                 tracer, "local",
                 _compile_local(codec.n_words, _local_engine()), *staged.words)
-        res = DistributedSortResult(out, N, dtype)
-        if return_result:
-            return res
-        with tracer.phase("decode"):
-            return res.to_numpy(tracer=tracer)
+        return _finish_local(DistributedSortResult(out, N, dtype),
+                             staged.fingerprint if verify_on else None)
 
     if staged is None and n_ranks == 1 and algorithm in ("radix", "sample"):
         engine = _local_engine()
@@ -1005,19 +1089,32 @@ def _sort_impl(
             # 64-bit local path: the adaptive pair-engine orchestration
             # (constant-word shortcut / dup sniff / pair bitonic + run
             # fix-up / lax fallback) — see _local_pair_sort.
-            out = _local_pair_sort(x, is_device, codec, dtype, mesh, tracer)
-            res = DistributedSortResult(out, N, dtype)
-            if return_result:
-                return res
-            with tracer.phase("decode"):
-                return res.to_numpy(tracer=tracer)
+            fp_in = None
+            pair_words = None
+            if not is_device:
+                # encode ONCE: the fingerprint and the pair sort share
+                # the words (a second O(n) encode pass would bill the
+                # verifier for work the sort needs anyway)
+                with tracer.phase("encode"):
+                    pair_words = codec.encode(np.asarray(x).reshape(-1))
+                if verify_on:
+                    with tracer.phase("verify"):
+                        fp_in = vfy.fingerprint_host(pair_words)
+            elif verify_on:
+                fp_in = _local_device_fp()
+            out = _local_pair_sort(x, is_device, codec, dtype, mesh, tracer,
+                                   words_np=pair_words)
+            return _finish_local(DistributedSortResult(out, N, dtype), fp_in)
         tracer.counters["local_engine"] = (
             "bitonic" if _use_bitonic(engine, codec.n_words, N)
             else "lax"
         )
         if is_device and _f64_known_broken(_device_platform(x), dtype, codec):
             x, is_device = _f64_host_input(x, tracer), False
+        fp_in = None
         if is_device:
+            if verify_on:
+                fp_in = _local_device_fp()
             try:
                 with tracer.phase("sort"):
                     out = _traced_call(
@@ -1037,6 +1134,9 @@ def _sort_impl(
         if not is_device:
             with tracer.phase("encode"):
                 words_np = codec.encode(x.reshape(-1))
+            if verify_on:
+                with tracer.phase("verify"):
+                    fp_in = vfy.fingerprint_host(words_np)
             with tracer.phase("device_put"):
                 words = tuple(
                     jax.device_put(w, mesh.devices.flat[0]) for w in words_np
@@ -1045,11 +1145,7 @@ def _sort_impl(
                 out = _traced_call(tracer, "local",
                                    _compile_local(codec.n_words,
                                                   _local_engine()), *words)
-        res = DistributedSortResult(out, N, dtype)
-        if return_result:
-            return res
-        with tracer.phase("decode"):
-            return res.to_numpy(tracer=tracer)
+        return _finish_local(DistributedSortResult(out, N, dtype), fp_in)
 
     #: per-word max^min already known without touching the data again
     #: (streamed ingest folds it chunk-by-chunk); None = plan from
@@ -1059,6 +1155,10 @@ def _sort_impl(
     #: consumed them (overflow retry / skew reroute); None disables
     #: donation for this input.
     rebuild_words = None
+    #: input fingerprint folded by an in-sort streamed ingest (the
+    #: device words may already carry an injected ingest fault, so the
+    #: fingerprint must come from the HOST-side chunk folds).
+    stream_fp = None
 
     if staged is not None:
         words = staged.words
@@ -1115,6 +1215,7 @@ def _sort_impl(
             words = st.words
             words_np = None
             plan_diffs = st.word_diffs
+            stream_fp = st.fingerprint
             rebuild_words = lambda: stream_to_mesh(  # noqa: E731
                 flat, mesh, tracer=tracer).words
         else:
@@ -1138,7 +1239,24 @@ def _sort_impl(
         # object now so a reuse fails with a clear error instead of
         # dispatching on deleted arrays
         staged.consumed = True
-    cap = _round_cap(int(n / n_ranks * cap_factor) + 1, align)
+
+    # ---- robustness layer (ISSUE 3): supervisor + input fingerprint --
+    sup = SortSupervisor(tracer, registry=reg)
+    input_fp = None
+    if verify_on:
+        with tracer.phase("verify"):
+            if staged is not None:
+                # folded chunk-by-chunk during streamed ingest — free
+                input_fp = staged.fingerprint
+            elif stream_fp is not None:
+                input_fp = stream_fp  # in-sort streamed ingest, same fold
+            elif words_np is not None:
+                input_fp = vfy.fingerprint_host(words_np)
+            else:
+                # device-resident padded words: one tiny fused reduction
+                input_fp = vfy.fingerprint_device(words, N)
+
+    base_cap = _round_cap(int(n / n_ranks * cap_factor) + 1, align)
     # Radix cap for skew reroutes: duplication that degenerates splitters
     # also concentrates a radix pass's send runs, so start at the same
     # O(n)-per-device bound the sample path enforces instead of paying
@@ -1146,15 +1264,92 @@ def _sort_impl(
     skew_cap = _round_cap(
         min(n, SAMPLE_CAP_LIMIT_FACTOR * max(1, -(-n // n_ranks))), align
     )
+    if oversample is None:
+        oversample = max(2 * n_ranks - 1, 8)
+    # Upper clamp: splitter quality saturates far below this, the
+    # [P, oversample] sample gather replicates to every device, and
+    # evenly_spaced_samples' int32 index math needs d^2 < 2^31.
+    oversample = min(oversample, n, 16_384)
 
-    res = None
-    if algorithm == "sample":
-        if oversample is None:
-            oversample = max(2 * n_ranks - 1, 8)
-        # Upper clamp: splitter quality saturates far below this, the
-        # [P, oversample] sample gather replicates to every device, and
-        # evenly_spaced_samples' int32 index math needs d^2 < 2^31.
-        oversample = min(oversample, n, 16_384)
+    # Live/dead tracking of the (possibly donated) input word buffers —
+    # the ONE place that knows whether the next dispatch must re-stage.
+    # Every dispatch of a donated program hands the words to XLA, so any
+    # rerun (overflow regrow, transient retry, verification retry,
+    # degradation rung) rebuilds through here.
+    _wstate = {"words": words, "dead": False}
+
+    def live_words():
+        if _wstate["dead"]:
+            _wstate["words"] = rebuild_words()
+            _wstate["dead"] = False
+        return _wstate["words"]
+
+    def mark_dead():
+        if donate:
+            _wstate["dead"] = True
+
+    def force_restage():
+        """After a verification failure the staged words themselves are
+        suspect (e.g. an ingest fault corrupted them after the
+        fingerprint fold) — re-stage from the source even when donation
+        is off, so the retry runs on freshly ingested data."""
+        if rebuild_words is not None:
+            _wstate["dead"] = True
+
+    _plan: dict = {}
+
+    def radix_plan():
+        if not _plan:
+            with tracer.phase("plan"):
+                if plan_diffs is not None:
+                    # Streamed ingest already folded per-word max^min
+                    # chunk-by-chunk — planning is free.
+                    diffs = plan_diffs
+                elif words_np is None:
+                    # Device-resident input: one scalar min/max sync per
+                    # word plans the pass count (pads replicate the max
+                    # key — range unchanged).
+                    ranges = _compile_word_range(dtype.name)(x.reshape(-1))
+                    diffs = tuple(int(lo) ^ int(hi) for lo, hi in ranges)
+                else:
+                    diffs = _word_diffs(words_np)
+                db = digit_bits if digit_bits is not None \
+                    else _auto_digit_bits(diffs)
+                _plan["p"] = (db, _passes_from_diffs(diffs, db))
+        return _plan["p"]
+
+    def run_radix(cap0: int) -> DistributedSortResult:
+        db, passes = radix_plan()
+
+        def attempt(c: int):
+            fn = _compile_radix(mesh, codec.n_words, n, db, c, passes,
+                                pack_impl, donate, sup.arm_exchange())
+            with tracer.phase("sort"):
+                out, max_cnt = sup.dispatch(
+                    "radix_spmd", fn, live_words, on_retry=mark_dead,
+                    n=n, cap=c, passes=passes, digit_bits=db, ranks=n_ranks)
+                mark_dead()
+                max_cnt = int(max_cnt)
+            # Exchange accounting (SURVEY.md §5 metrics row), counted per
+            # attempt so discarded overflow retries — whose all_to_all
+            # traffic really crossed the links — are included: the padded
+            # exchange ships full [P, cap] word blocks; wire bytes
+            # exclude the self-block, which never leaves the device.
+            tracer.count(
+                "exchange_bytes",
+                passes * n_ranks * (n_ranks - 1) * c * 4 * codec.n_words,
+            )
+            return out, max_cnt
+
+        out, cap = sup.exchange_loop(
+            "radix", attempt, sup.squeeze_cap(cap0, align), align,
+            _round_cap, on_overflow=mark_dead)
+        tracer.count("exchange_passes", passes)
+        tracer.counters["exchange_cap"] = cap  # last cap, not accumulated
+        tracer.counters["digit_bits"] = db     # auto-resolved width
+        return DistributedSortResult(out, N, dtype)
+
+    def run_sample() -> DistributedSortResult:
         if words_np is not None:
             degenerate = _sample_skew_sniff(words_np, n_ranks)
         else:
@@ -1163,7 +1358,8 @@ def _sort_impl(
             # it, skewed device inputs would only discover degeneracy via
             # a failed exchange round + recompile (VERDICT r2 #4).
             degenerate = bool(
-                _compile_skew_sniff(mesh, codec.n_words, N, n_ranks)(*words)
+                _compile_skew_sniff(mesh, codec.n_words, N, n_ranks)(
+                    *live_words())
             )
         if degenerate:
             tracer.verbose(
@@ -1171,104 +1367,162 @@ def _sort_impl(
                 "routing to radix (skew-immune)"
             )
             tracer.count("sample_skew_fallback", 1)
-            algorithm = "radix"
-            cap = skew_cap
-        else:
-            cap_limit = _round_cap(
-                SAMPLE_CAP_LIMIT_FACTOR * max(1, -(-n // n_ranks)), align
-            )
-            spmd_engine = (_bitonic_impl() if _use_bitonic(_local_engine(),
-                                                           codec.n_words, n)
-                           else "lax")
-            tracer.counters["local_engine"] = spmd_engine
-            while True:
-                fn = _compile_sample(mesh, codec.n_words, n, cap, oversample,
-                                     pack_impl, spmd_engine, donate)
-                with tracer.phase("sort"):
-                    out, counts, max_cnt = _traced_call(
-                        tracer, "sample_spmd", fn, *words,
-                        n=n, cap=cap, ranks=n_ranks)
-                    max_cnt = int(max_cnt)
-                tracer.count(
-                    "exchange_bytes",
-                    n_ranks * (n_ranks - 1) * cap * 4 * codec.n_words,
-                )
-                if max_cnt <= cap:
-                    break
-                need = _round_cap(max_cnt, align)
-                if donate:
-                    # the dispatch consumed the input words; re-stage
-                    # before ANY rerun (retry here or radix reroute below)
-                    words = rebuild_words()
-                if need > cap_limit:
-                    tracer.verbose(
-                        f"sample exchange needs cap {max_cnt} > O(n) bound "
-                        f"{cap_limit}; routing to radix (skew-immune)"
-                    )
-                    tracer.count("sample_skew_fallback", 1)
-                    algorithm = "radix"
-                    cap = skew_cap
-                    break
-                tracer.verbose(
-                    f"sample exchange overflow (need {max_cnt} > cap {cap}); retrying")
-                tracer.count("exchange_retries", 1)
-                cap = need
-            if algorithm == "sample":
-                tracer.count("exchange_passes", 1)
-                tracer.counters["exchange_cap"] = cap
-                counts = np.asarray(counts)
-                res = DistributedSortResult(
-                    out, N, dtype, counts=counts, shard_slots=n_ranks * cap
-                )
+            return run_radix(skew_cap)
+        cap_limit = _round_cap(
+            SAMPLE_CAP_LIMIT_FACTOR * max(1, -(-n // n_ranks)), align
+        )
+        spmd_engine = (_bitonic_impl() if _use_bitonic(_local_engine(),
+                                                       codec.n_words, n)
+                       else "lax")
+        tracer.counters["local_engine"] = spmd_engine
 
-    if res is None and algorithm == "radix":
-        with tracer.phase("plan"):
-            if plan_diffs is not None:
-                # Streamed ingest already folded per-word max^min
-                # chunk-by-chunk — planning is free.
-                diffs = plan_diffs
-            elif words_np is None:
-                # Device-resident input: one scalar min/max sync per word
-                # plans the pass count (pads replicate the max key — range
-                # unchanged).
-                ranges = _compile_word_range(dtype.name)(x.reshape(-1))
-                diffs = tuple(int(lo) ^ int(hi) for lo, hi in ranges)
-            else:
-                diffs = _word_diffs(words_np)
-            if digit_bits is None:
-                digit_bits = _auto_digit_bits(diffs)
-            passes = _passes_from_diffs(diffs, digit_bits)
-        while True:
-            fn = _compile_radix(mesh, codec.n_words, n, digit_bits, cap, passes,
-                                pack_impl, donate)
+        def attempt(c: int):
+            fn = _compile_sample(mesh, codec.n_words, n, c, oversample,
+                                 pack_impl, spmd_engine, donate,
+                                 sup.arm_exchange())
             with tracer.phase("sort"):
-                out, max_cnt = _traced_call(
-                    tracer, "radix_spmd", fn, *words,
-                    n=n, cap=cap, passes=passes, digit_bits=digit_bits,
-                    ranks=n_ranks)
+                out, counts, max_cnt = sup.dispatch(
+                    "sample_spmd", fn, live_words, on_retry=mark_dead,
+                    n=n, cap=c, ranks=n_ranks)
+                mark_dead()
                 max_cnt = int(max_cnt)
-            # Exchange accounting (SURVEY.md §5 metrics row), counted per
-            # attempt so discarded overflow retries — whose all_to_all
-            # traffic really crossed the links — are included: the padded
-            # exchange ships full [P, cap] word blocks; wire bytes exclude
-            # the self-block, which never leaves the device.
             tracer.count(
                 "exchange_bytes",
-                passes * n_ranks * (n_ranks - 1) * cap * 4 * codec.n_words,
+                n_ranks * (n_ranks - 1) * c * 4 * codec.n_words,
             )
-            if max_cnt <= cap:
-                break
-            tracer.verbose(f"radix exchange overflow (need {max_cnt} > cap {cap}); retrying")
-            tracer.count("exchange_retries", 1)
-            cap = _round_cap(max_cnt, align)
-            if donate:
-                words = rebuild_words()  # donated input died with the call
-        tracer.count("exchange_passes", passes)
-        tracer.counters["exchange_cap"] = cap  # last cap, not accumulated
-        tracer.counters["digit_bits"] = digit_bits  # auto-resolved width
-        res = DistributedSortResult(out, N, dtype)
-    assert res is not None
+            return (out, counts), max_cnt
 
+        try:
+            (out, counts), cap = sup.exchange_loop(
+                "sample", attempt, sup.squeeze_cap(base_cap, align), align,
+                _round_cap, cap_limit=cap_limit, on_overflow=mark_dead)
+        except ExchangeCapExceeded as e:
+            tracer.verbose(
+                f"sample exchange needs cap {e.need} > O(n) bound "
+                f"{e.limit}; routing to radix (skew-immune)"
+            )
+            tracer.count("sample_skew_fallback", 1)
+            return run_radix(skew_cap)
+        tracer.count("exchange_passes", 1)
+        tracer.counters["exchange_cap"] = cap
+        return DistributedSortResult(
+            out, N, dtype, counts=np.asarray(counts),
+            shard_slots=n_ranks * cap
+        )
+
+    def run_host() -> tuple:
+        """Last degradation rung: host lexsort over the encoded words —
+        no device dispatch at all, so it survives a dead backend.  The
+        result is fingerprint-verified on the host before anyone sees
+        it."""
+        tracer.verbose("graceful degradation: host lexsort fallback")
+        if staged is not None:
+            if staged.source is None:
+                raise SortIntegrityError(
+                    "host fallback impossible: StagedIngest kept no source")
+            arr = np.asarray(staged.source).reshape(-1)
+        else:
+            arr = np.asarray(x).reshape(-1)
+        with tracer.phase("sort"):
+            w = codec.encode(arr)
+            # np.lexsort: last key is primary -> feed words lsw-first
+            order = np.lexsort(tuple(reversed(w)))
+            sorted_w = tuple(wi[order] for wi in w)
+        if verify_on and input_fp is not None:
+            with tracer.phase("verify"):
+                out_fp = vfy.fingerprint_host(sorted_w)
+            tracer.count("verify_runs", 1)
+            if out_fp != input_fp:
+                raise SortIntegrityError(
+                    "host fallback result failed fingerprint verification "
+                    "(input changed between ingest and fallback?)")
+        return sorted_w
+
+    # ---- degradation ladder: requested algorithm -> the other one ->
+    # host lexsort.  Each rung gets one verification retry (a transient
+    # corruption re-dispatches clean); persistent dispatch failure or
+    # repeated verification failure moves down.  The ladder ends in a
+    # VERIFIED result or a typed error — never a silent wrong answer.
+    levels = [algorithm]
+    if supervision.fallback_enabled():
+        levels.append("sample" if algorithm == "radix" else "radix")
+        levels.append("host")
+
+    res = None
+    host_words = None
+    last_err: Exception | None = None
+    level = levels[0]
+    for level in levels:
+        if level != levels[0]:
+            tracer.verbose(f"degrading to the {level} path")
+        done = False
+        for ver_try in range(2 if verify_on else 1):
+            try:
+                if level == "host":
+                    host_words = run_host()
+                    done = True
+                    break
+                cand = run_sample() if level == "sample" else \
+                    run_radix(base_cap)
+                cand = faults.maybe_corrupt_result(reg, cand)
+                ok = not verify_on or _check_result(cand, input_fp)
+            except SortRetryExhausted as e:
+                last_err = e
+                tracer.verbose(f"{level} path failed persistently: {e}")
+                break
+            except jax.errors.JaxRuntimeError as e:
+                # A dead device can also surface OUTSIDE the supervised
+                # sort dispatch — the skew sniff, the pass-planner
+                # reduction, the verifier program.  The ladder exists
+                # for exactly this: degrade instead of leaking an
+                # untyped error past the typed-error contract (the host
+                # rung needs no device at all).
+                last_err = SortRetryExhausted(
+                    f"{level} path failed outside the sort dispatch: "
+                    f"{e}")
+                last_err.__cause__ = e
+                tracer.count("sort_retries", 1)
+                tracer.verbose(f"{level} path device failure: "
+                               f"{type(e).__name__}; degrading")
+                break
+            if ok:
+                res = cand
+                done = True
+                break
+            tracer.count("verify_failures", 1)
+            force_restage()  # the input words themselves are suspect
+        if done:
+            break
+    if res is None and host_words is None:
+        if last_err is not None:
+            raise last_err
+        raise SortIntegrityError(
+            "no sort path produced a verified result (verify_failures="
+            f"{int(tracer.counters.get('verify_failures', 0))})")
+
+    if host_words is not None:
+        tracer.counters["degraded_to"] = "host"
+        out_np = codec.decode(host_words)
+        if not return_result:
+            return out_np
+        # best-effort re-stage of the host-sorted words onto the mesh
+        # (already globally sorted; pads = max copies keep the contract).
+        # If the device is GENUINELY dead — the scenario this rung
+        # survives — the re-stage fails too: return a host-backed result
+        # instead of leaking an untyped JaxRuntimeError past the typed-
+        # error contract (DistributedSortResult's decode/probe paths are
+        # plain array ops, so numpy words work throughout).
+        try:
+            pad = _host_pad_words(codec, out_np, dtype, n_ranks * n)
+            return DistributedSortResult(
+                _shard_input(host_words, mesh, n, pad), N, dtype)
+        except jax.errors.JaxRuntimeError:
+            tracer.verbose("device unavailable for re-staging the host "
+                           "fallback result; returning host-backed words")
+            return DistributedSortResult(host_words, N, dtype)
+
+    if level != levels[0]:
+        tracer.counters["degraded_to"] = level
     if return_result:
         return res
     with tracer.phase("decode"):

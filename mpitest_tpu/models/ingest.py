@@ -60,6 +60,9 @@ from dataclasses import dataclass, field
 import jax
 import numpy as np
 
+from mpitest_tpu import faults
+from mpitest_tpu.models.supervisor import verify_enabled
+from mpitest_tpu.models.verify import Fingerprint, fingerprint_host
 from mpitest_tpu.ops.keys import codec_for
 from mpitest_tpu.parallel.mesh import assemble_sharded, shard_bounds
 from mpitest_tpu.utils import io as kio
@@ -161,6 +164,11 @@ class StagedIngest:
     #: sort() raises on reuse instead of dispatching on deleted arrays
     #: (use :meth:`rebuild` for another sort).
     consumed: bool = False
+    #: input-side multiset fingerprint (models/verify.py), folded
+    #: chunk-by-chunk by the encode workers — the half the always-on
+    #: output verifier compares against; None only when verification
+    #: was disabled during staging.
+    fingerprint: "Fingerprint | None" = None
 
     @property
     def size(self) -> int:
@@ -179,12 +187,19 @@ class StagedIngest:
 class _StreamState:
     """Cross-thread accumulator for stats and planner inputs."""
 
-    def __init__(self, n_words: int):
+    def __init__(self, n_words: int, fold_fp: bool = True):
         self.lock = threading.Lock()
         self.word_min = [None] * n_words
         self.word_max = [None] * n_words
         self.native_max = None
         self.stats = IngestStats()
+        #: running input fingerprint (models/verify.py): XOR + wrapping
+        #: sum + count per word, folded chunk-by-chunk so the output
+        #: verifier needs no second pass over the data.  ``fold_fp=False``
+        #: (SORT_VERIFY=0) skips the per-chunk scans entirely — the A/B
+        #: baseline must not silently pay verification cost.
+        self.fold_fp = fold_fp
+        self.fp = Fingerprint.empty(n_words) if fold_fp else None
 
     def fold_chunk(self, chunk, words, t0: float, dt_s: float) -> None:
         # full-chunk scans OUTSIDE the lock (they are the expensive
@@ -193,9 +208,14 @@ class _StreamState:
         los = [int(w.min()) for w in words]
         his = [int(w.max()) for w in words]
         m = chunk.max() if chunk.dtype.kind != "f" else None
+        # one digest definition (models/verify.py) — the scan runs
+        # outside the lock like the min/max folds above
+        chunk_fp = fingerprint_host(words) if self.fold_fp else None
         with self.lock:
             self.stats.encode_s += dt_s
             self.stats.host_iv.append((t0, t0 + dt_s))
+            if chunk_fp is not None:
+                self.fp = self.fp.combine(chunk_fp)
             for i, (lo, hi) in enumerate(zip(los, his)):
                 if self.word_min[i] is None or lo < self.word_min[i]:
                     self.word_min[i] = lo
@@ -242,7 +262,7 @@ def stream_to_mesh(x, mesh, tracer=None, chunk_elems: int | None = None,
     total = n_ranks * n
     bounds = shard_bounds(mesh, n)
     spans = _spans_of(tracer)
-    state = _StreamState(codec.n_words)
+    state = _StreamState(codec.n_words, fold_fp=verify_enabled())
     state.stats.n = N
     # chunk k's pieces per device, appended in chunk order by the single
     # transfer thread: per_dev[d] = [piece0_words, piece1_words, ...]
@@ -304,6 +324,10 @@ def stream_to_mesh(x, mesh, tracer=None, chunk_elems: int | None = None,
         words = codec.encode(chunk)
         dt = time.perf_counter() - t0
         state.fold_chunk(chunk, words, t0, dt)
+        # fault injection (SORT_FAULTS=ingest_poison): corrupt AFTER the
+        # fingerprint fold — the device receives bytes the fingerprint
+        # never saw, which the output verifier must flag.
+        words = faults.maybe_poison_chunk(words, k)
         if spans is not None:
             spans.record("ingest.encode", t0, dt, chunk=k,
                          n=int(chunk.size),
@@ -435,6 +459,7 @@ def stream_to_mesh(x, mesh, tracer=None, chunk_elems: int | None = None,
         word_diffs=state.word_diffs(codec.n_words), mesh=mesh,
         stats=state.stats, source=x,
         tracer=tracer, chunk_elems=chunk_elems, threads=threads,
+        fingerprint=state.fp,
     )
 
 

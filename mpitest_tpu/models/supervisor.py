@@ -1,0 +1,233 @@
+"""SortSupervisor — retries, cap regrow, and graceful degradation.
+
+Before this layer, ``models/api.py`` had two ad-hoc overflow-retry
+loops (sample and radix each re-deriving "grow the cap, rebuild donated
+words, count the retry") and NO policy for a dispatch that throws: a
+transient ``JaxRuntimeError`` — a preempted device, a fleeting OOM —
+killed the whole sort.  The reference is worse still: its failure
+"policy" is silent truncation and stranded peers (SURVEY §7.4).
+
+The supervisor centralizes all of it:
+
+* :meth:`dispatch` — every SPMD program launch goes through one bounded
+  retry loop with exponential backoff (``SORT_MAX_RETRIES`` /
+  ``SORT_RETRY_BACKOFF``).  Each failed attempt emits a
+  ``supervisor_retry`` span; donated input words are rebuilt before the
+  re-launch (a failed donated dispatch may have consumed them).  The
+  fault registry's ``dispatch_error`` / ``dispatch_oom`` sites inject
+  here, so the retry path is exercised without a flaky device.
+* :meth:`exchange_loop` — THE cap-regrow loop, shared by both
+  algorithms: run an attempt at the current cap, grow to the reported
+  need on overflow, rebuild donated words, and surface a typed
+  :class:`ExchangeCapExceeded` when the need crosses the caller's O(n)
+  bound (the sample→radix skew reroute keeps its policy in api.py; the
+  mechanics live here, once).
+* **Degradation ladder** (driven by ``_sort_impl``): requested
+  algorithm → the other algorithm → host ``np.lexsort`` — taken only on
+  persistent dispatch failure or repeated verification failure, and
+  every rung's result still faces the same fingerprint verification.
+  The ladder ends in a *verified* result or a typed error
+  (:class:`SortIntegrityError` / :class:`SortRetryExhausted`), never a
+  silent wrong answer.  ``SORT_FALLBACK=0`` pins the requested
+  algorithm (benchmarks, parity tests).
+
+The CLI maps the two terminal errors to distinct exit codes
+(``drivers/sort_cli.py``), and every retry / fault / verification event
+lands in the span stream the report CLI aggregates — robustness is
+observable, not just present.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from mpitest_tpu import faults as flt
+
+
+class SortFaultError(RuntimeError):
+    """Base of the supervisor's typed terminal errors."""
+
+
+class SortIntegrityError(SortFaultError):
+    """Every recovery rung was exhausted without producing a result that
+    passes the sortedness + fingerprint verification — the caller must
+    treat the sort as failed (never as approximately right)."""
+
+
+class SortRetryExhausted(SortFaultError):
+    """Dispatch kept failing past the retry budget (and fallback was
+    disabled or also failed); the underlying error is ``__cause__``."""
+
+
+class ExchangeCapExceeded(Exception):
+    """Internal control flow of :meth:`SortSupervisor.exchange_loop`:
+    the exchange needs a cap beyond the caller's bound."""
+
+    def __init__(self, need: int, limit: int):
+        super().__init__(f"exchange needs cap {need} > bound {limit}")
+        self.need = need
+        self.limit = limit
+
+
+def max_retries() -> int:
+    v = os.environ.get("SORT_MAX_RETRIES", "2")
+    try:
+        n = int(v)
+    except ValueError:
+        n = -1
+    if n < 0:
+        raise ValueError(f"SORT_MAX_RETRIES={v!r}: use an integer >= 0")
+    return n
+
+
+def retry_backoff() -> float:
+    v = os.environ.get("SORT_RETRY_BACKOFF", "0.05")
+    try:
+        b = float(v)
+    except ValueError:
+        b = -1.0
+    if not b >= 0.0:
+        raise ValueError(f"SORT_RETRY_BACKOFF={v!r}: use a number >= 0")
+    return b
+
+
+def fallback_enabled() -> bool:
+    v = os.environ.get("SORT_FALLBACK", "1")
+    if v not in ("0", "1"):
+        raise ValueError(f"SORT_FALLBACK={v!r}: use '1' or '0'")
+    return v == "1"
+
+
+def verify_enabled() -> bool:
+    v = os.environ.get("SORT_VERIFY", "1")
+    if v not in ("0", "1"):
+        raise ValueError(f"SORT_VERIFY={v!r}: use '1' or '0'")
+    return v == "1"
+
+
+def wire_registry(reg, tracer) -> None:
+    """Point a fault registry's ``on_fire`` at a tracer: every injected
+    fault becomes a ``fault`` span event + a ``faults_injected`` count.
+    Wired as early as possible in a run — the ingest-poison site fires
+    inside the streaming pipeline, long before the dispatch supervisor
+    exists."""
+    if reg is None:
+        return
+
+    def _on_fault(site: str, detail: dict) -> None:
+        tracer.count("faults_injected", 1)
+        tracer.spans.record("fault", time.perf_counter(), 0.0,
+                            site=site, **{k: v for k, v in detail.items()
+                                          if k != "word"})
+
+    reg.on_fire = _on_fault
+
+
+class SortSupervisor:
+    """Per-run supervisor: owns the retry budget, the fault registry
+    hookup, and the shared cap-regrow loop.  One instance per sort()."""
+
+    def __init__(self, tracer, registry: "flt.FaultRegistry | None" = None):
+        self.tracer = tracer
+        self.registry = registry
+        self.max_retries = max_retries()
+        self.backoff = retry_backoff()
+        wire_registry(registry, tracer)
+
+    # -- fault arming -------------------------------------------------
+    def squeeze_cap(self, cap: int, floor: int) -> int:
+        """``cap_squeeze`` site: collapse the initial exchange cap to the
+        alignment floor so the overflow-retry path runs for real."""
+        if self.registry is not None and self.registry.fire(
+                "cap_squeeze", cap=cap, floor=floor):
+            return floor
+        return cap
+
+    def arm_exchange(self) -> str:
+        """Compile token for the trace-time exchange faults ('' = clean,
+        cache-shared compile)."""
+        return flt.arm_exchange(self.registry)
+
+    def _inject_dispatch_fault(self) -> None:
+        import jax
+
+        reg = self.registry
+        if reg is None:
+            return
+        if reg.fire("dispatch_oom"):
+            raise jax.errors.JaxRuntimeError(
+                "RESOURCE_EXHAUSTED: injected fault (SORT_FAULTS=dispatch_oom)")
+        if reg.fire("dispatch_error"):
+            raise jax.errors.JaxRuntimeError(
+                "INTERNAL: injected fault (SORT_FAULTS=dispatch_error)")
+
+    # -- dispatch with bounded retry + backoff ------------------------
+    def dispatch(self, label: str, fn, args_fn, on_retry=None, **attrs):
+        """Run ``fn(*args_fn())`` under the retry budget.  ``args_fn`` is
+        re-evaluated per attempt (donated buffers must be re-staged
+        after a failed attempt — ``on_retry`` marks them dead so the
+        caller's rebuild kicks in)."""
+        import jax
+
+        from mpitest_tpu.models.api import _traced_call
+
+        attempt = 0
+        while True:
+            try:
+                self._inject_dispatch_fault()
+                return _traced_call(self.tracer, label, fn, *args_fn(),
+                                    **attrs)
+            except jax.errors.JaxRuntimeError as e:
+                # an exchange fault armed for THIS dispatch may not have
+                # been consumed (the program never traced) — drop it so
+                # it cannot leak into a later clean compile.  It was
+                # counted as injected at arm time but never touched
+                # data: faults_dropped keeps the ledger honest.
+                dropped = flt.drop_pending()
+                if dropped:
+                    self.tracer.count("faults_dropped", dropped)
+                if attempt >= self.max_retries:
+                    raise SortRetryExhausted(
+                        f"{label} failed {attempt + 1} time(s); retry "
+                        f"budget exhausted: {e}") from e
+                delay = min(self.backoff * (2 ** attempt), 2.0)
+                self.tracer.verbose(
+                    f"{label} dispatch failed ({type(e).__name__}); "
+                    f"retry {attempt + 1}/{self.max_retries} in {delay:.2f}s")
+                self.tracer.count("sort_retries", 1)
+                self.tracer.spans.record(
+                    "supervisor_retry", time.perf_counter(), 0.0,
+                    label=label, attempt=attempt + 1,
+                    error=type(e).__name__)
+                if on_retry is not None:
+                    on_retry()
+                if delay:
+                    time.sleep(delay)
+                attempt += 1
+
+    # -- the ONE cap-regrow loop --------------------------------------
+    def exchange_loop(self, label: str, attempt, cap: int, align: int,
+                      round_cap, cap_limit: int | None = None,
+                      on_overflow=None):
+        """Run ``attempt(cap) -> (payload, max_cnt)`` until the exchange
+        fits; grow the cap to the reported need otherwise.  The cap only
+        ever grows (bounded by the shard size), so the loop terminates.
+        ``cap_limit``: raise :class:`ExchangeCapExceeded` when the need
+        crosses it (the sample path's O(n) recv-memory bound).
+        ``on_overflow``: invalidate donated input words before any
+        rerun."""
+        while True:
+            payload, max_cnt = attempt(cap)
+            if max_cnt <= cap:
+                return payload, cap
+            need = round_cap(max_cnt, align)
+            if on_overflow is not None:
+                on_overflow()
+            if cap_limit is not None and need > cap_limit:
+                raise ExchangeCapExceeded(max_cnt, cap_limit)
+            self.tracer.verbose(
+                f"{label} exchange overflow (need {max_cnt} > cap {cap}); "
+                "retrying")
+            self.tracer.count("exchange_retries", 1)
+            cap = need

@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from mpitest_tpu import compat
+from mpitest_tpu import compat, faults
 from mpitest_tpu.parallel.mesh import AXIS
 from mpitest_tpu.utils import spans
 
@@ -173,5 +173,13 @@ def ragged_all_to_all(
         recv = lax.all_to_all(send, axis, 0, 0, tiled=True)
         recv_arrays.append(recv)
 
+    # Fault injection (ISSUE 3): the armed exchange fault lands HERE —
+    # between the all_to_all and the receiver's local sort/merge — the
+    # exact window where the reference's overflow bug corrupted data.
+    # No-op (and not even traced) unless the dispatching supervisor
+    # armed a fault for this compile (mpitest_tpu/faults.py).
+    recv_t, recv_cnt = faults.apply_exchange_fault(tuple(recv_arrays),
+                                                   recv_cnt)
+
     max_send_cnt = lax.pmax(send_cnt.max(), axis)
-    return tuple(recv_arrays), recv_cnt, max_send_cnt
+    return recv_t, recv_cnt, max_send_cnt

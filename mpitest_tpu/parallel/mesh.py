@@ -72,9 +72,52 @@ def multihost_init(coordinator: str | None = None, num_processes: int | None = N
     equivalent of ``MPI_Init`` across nodes; collectives then ride
     ICI within a slice and DCN across slices with no algorithm changes.
     No-op when running single-process (the common case in tests/bench).
+
+    Arguments are validated HERE, fail-fast: a malformed coordinator
+    address or an out-of-range process id used to surface as a deep JAX
+    hang or traceback minutes into the handshake — on a 16-host launch
+    that is 15 healthy hosts blocked on one typo.  All three arguments
+    are required together (partial configuration is always a launcher
+    bug, never a valid topology).
     """
-    if coordinator is None and num_processes is None:
+    if coordinator is None and num_processes is None and process_id is None:
         return  # single-process: nothing to do
+    missing = [name for name, v in (("coordinator", coordinator),
+                                    ("num_processes", num_processes),
+                                    ("process_id", process_id))
+               if v is None]
+    if missing:
+        raise ValueError(
+            "multihost_init needs coordinator, num_processes and "
+            f"process_id together; missing: {', '.join(missing)} "
+            "(call with no arguments for single-process)")
+    host, sep, port = str(coordinator).rpartition(":")
+    # host.endswith(':') catches port-less IPv6-style typos ('::1',
+    # 'fe80::1'): rpartition would split them into a "host" of colons
+    # plus a digit-like "port" and wave through exactly the deep-hang
+    # address class this validation exists to stop.  Bracketed IPv6
+    # ('[::1]:8476') parses fine.
+    if not sep or not host or host.endswith(":"):
+        raise ValueError(
+            f"multihost_init: coordinator {coordinator!r} is not "
+            "'host:port' (e.g. '10.0.0.2:8476'; bracket IPv6 hosts as "
+            "'[::1]:8476')")
+    try:
+        port_n = int(port)
+    except ValueError:
+        port_n = -1
+    if not 1 <= port_n <= 65535:
+        raise ValueError(
+            f"multihost_init: coordinator port {port!r} is not in "
+            "[1, 65535]")
+    if not isinstance(num_processes, int) or num_processes < 1:
+        raise ValueError(
+            f"multihost_init: num_processes={num_processes!r} must be an "
+            "integer >= 1")
+    if not isinstance(process_id, int) or not 0 <= process_id < num_processes:
+        raise ValueError(
+            f"multihost_init: process_id={process_id!r} must be an integer "
+            f"in [0, {num_processes})")
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=num_processes,

@@ -105,6 +105,8 @@ def aggregate(rows: list[dict]) -> dict:
                "metrics": {metric: latest bench/metrics value row},
                "spans": {name: count},
                "ingest": {stage: {seconds, count, bytes}},
+               "robustness": {faults, fault_sites, retries, verify_runs,
+                              verify_failures},
                "ingest_overlap"/"egress_overlap":
                    {host_s, transfer_s, overlap_s, pct} | None}``.
     Collective sources are ``tpu`` (span events, mapped through
@@ -115,6 +117,11 @@ def aggregate(rows: list[dict]) -> dict:
     metrics: dict[str, dict] = {}
     span_counts: dict[str, int] = {}
     ingest: dict[str, dict] = {}
+    # robustness events (ISSUE 3): injected faults, supervisor retries
+    # and verification outcomes ride the same span stream — fold them
+    # into one table so a chaos run's telemetry is one `report` away.
+    robust = {"faults": 0, "fault_sites": {}, "retries": 0,
+              "verify_runs": 0, "verify_failures": 0}
     # overlap intervals grouped per (file, pid): t0 is a process-relative
     # perf_counter clock, so intervals from different runs appended to
     # one SORT_TRACE file live on unrelated timelines — comparing them
@@ -144,6 +151,17 @@ def aggregate(rows: list[dict]) -> dict:
                 add_coll("tpu", MPI_EQUIV[name], 1,
                          obj.get("attrs", {}).get("bytes", 0),
                          obj.get("dt", 0.0))
+            elif name == "fault":
+                robust["faults"] += 1
+                site = obj.get("attrs", {}).get("site", "?")
+                robust["fault_sites"][site] = \
+                    robust["fault_sites"].get(site, 0) + 1
+            elif name == "supervisor_retry":
+                robust["retries"] += 1
+            elif name == "verify":
+                robust["verify_runs"] += 1
+                if not obj.get("attrs", {}).get("ok", True):
+                    robust["verify_failures"] += 1
             elif name in INGEST_HOST_STAGES or name in INGEST_XFER_STAGES:
                 row = ingest.setdefault(
                     name, {"seconds": 0.0, "count": 0, "bytes": 0})
@@ -190,7 +208,7 @@ def aggregate(rows: list[dict]) -> dict:
                 "pct": 100.0 * ov / xfer_s if xfer_s > 0 else 0.0}
 
     return {"phases": phases, "collectives": colls, "metrics": metrics,
-            "spans": span_counts, "ingest": ingest,
+            "spans": span_counts, "ingest": ingest, "robustness": robust,
             "ingest_overlap": direction_overlap("ingest"),
             "egress_overlap": direction_overlap("egress")}
 
@@ -329,6 +347,17 @@ def render(agg: dict) -> str:
                 out.append(
                     f"  {label} overlap: {ov['overlap_s']:.6f}s "
                     f"({ov['pct']:.1f}% of {ov['transfer_s']:.6f}s transfer)")
+    rb = agg.get("robustness") or {}
+    if any(rb.get(k) for k in ("faults", "retries", "verify_runs")):
+        out.append("")
+        out.append("robustness (supervisor + verifier events)")
+        out.append(f"  verify runs {rb['verify_runs']}, "
+                   f"failures {rb['verify_failures']}; "
+                   f"dispatch retries {rb['retries']}; "
+                   f"faults injected {rb['faults']}"
+                   + (" (" + ", ".join(f"{s}={c}" for s, c in
+                                       sorted(rb["fault_sites"].items()))
+                      + ")" if rb["fault_sites"] else ""))
     if agg["metrics"]:
         out.append("")
         out.append("metrics (latest row per name)")

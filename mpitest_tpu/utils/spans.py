@@ -28,6 +28,14 @@ A warm (cache-hit) jit call re-emits nothing; the report CLI aggregates
 per compiled program, exactly like ``COMM_STATS`` aggregates per native
 run.
 
+Robustness vocabulary (ISSUE 3): the supervisor/verifier layer emits
+``fault`` (one point event per injected fault, attrs: site/seq),
+``supervisor_retry`` (one per retried dispatch, attrs: label/attempt/
+error) and ``verify`` (one per verification, attrs: ok/sorted_ok/fp_ok)
+— all point events on this same stream, aggregated by the report CLI's
+robustness table, so a chaos drill's evidence rides the ordinary
+``SORT_TRACE`` file.
+
 Thread model: one SpanLog per Tracer.  The *nesting* API (``span()`` /
 ``event()``) remains single-threaded — only the host driver thread opens
 nested spans.  Pipeline worker threads (the streaming ingest/egress

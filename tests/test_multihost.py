@@ -33,6 +33,38 @@ def test_multihost_init_noop_single_process():
     multihost_init()
 
 
+def test_multihost_init_validates_args_fail_fast():
+    """ISSUE 3 satellite: malformed coordinator/process arguments must
+    raise a clear ValueError IMMEDIATELY — before this change they
+    reached jax.distributed.initialize and surfaced as a deep hang or
+    an opaque traceback minutes into the handshake."""
+    import pytest
+
+    from mpitest_tpu.parallel import multihost_init
+
+    # partial configuration: always a launcher bug
+    with pytest.raises(ValueError, match="missing: num_processes"):
+        multihost_init("127.0.0.1:9999")
+    with pytest.raises(ValueError, match="missing: coordinator"):
+        multihost_init(num_processes=2, process_id=0)
+    with pytest.raises(ValueError, match="missing:"):
+        multihost_init(process_id=1)
+    # malformed coordinator address — including port-less IPv6-style
+    # typos ('::1', 'fe80::1'), which rpartition alone would wave
+    # through as host=':'+port='1' (review regression)
+    for bad in ("coordinatorhost", ":1234", "host:", "host:notaport",
+                "host:0", "host:70000", "::1", "fe80::1"):
+        with pytest.raises(ValueError, match="coordinator"):
+            multihost_init(bad, num_processes=2, process_id=0)
+    # out-of-range process topology
+    with pytest.raises(ValueError, match="num_processes"):
+        multihost_init("h:1234", num_processes=0, process_id=0)
+    with pytest.raises(ValueError, match="process_id"):
+        multihost_init("h:1234", num_processes=2, process_id=2)
+    with pytest.raises(ValueError, match="process_id"):
+        multihost_init("h:1234", num_processes=2, process_id=-1)
+
+
 def test_multihost_init_executes():
     """``multihost_init`` actually EXECUTES ``jax.distributed.initialize``
     (coordinator bind + handshake with itself, num_processes=1) and the

@@ -656,6 +656,69 @@ def test_backend_mpi_builds_without_mpicc(tmp_path, rng):
         assert median in run.stdout
 
 
+def test_comm_faults_stall_is_harmless(binaries, tmp_path, rng):
+    """COMM_FAULTS=stall:<rank>@<nth>:<ms> — a slow rank costs wall
+    time, never correctness: peers wait in the barrier and the output
+    stays byte-exact (ISSUE 3: the native mirror of SORT_FAULTS)."""
+    keys = rng.integers(-(2**31), 2**31 - 1, size=10_000, dtype=np.int32)
+    p = write_keys(tmp_path, keys)
+    r = run_native(binaries["radix"], p, ranks=4,
+                   env={"COMM_FAULTS": "stall:1@2:50"})
+    assert r.returncode == 0, r.stderr
+    assert "[FAULT] rank 1 stalling" in r.stderr
+    ref = np.sort(keys)
+    assert f"The n/2-th sorted element: {ref[4999]}" in r.stdout
+
+
+def test_comm_faults_kill_local_fails_loudly(binaries, tmp_path, rng):
+    """A rank killed mid-protocol on the pthreads backend takes the
+    process down with the fault code and a [FAULT] line — never a
+    silent hang (the reference strands peers in this situation)."""
+    keys = rng.integers(-(2**31), 2**31 - 1, size=5_000, dtype=np.int32)
+    p = write_keys(tmp_path, keys)
+    r = run_native(binaries["radix"], p, ranks=4,
+                   env={"COMM_FAULTS": "kill:1@3"})
+    assert r.returncode == 43, (r.returncode, r.stderr)
+    assert "[FAULT] rank 1 killed" in r.stderr
+
+
+def test_comm_faults_kill_minimpi_kills_job(minimpi_binaries, tmp_path, rng):
+    """Under the multi-process runtime the killed rank is a real child
+    process: the minimpi supervisor must reap it and bring the WHOLE
+    job down with the fault code (mpirun contract) — within the
+    timeout, i.e. no stranded-peer hang."""
+    keys = rng.integers(-(2**31), 2**31 - 1, size=5_000, dtype=np.int32)
+    p = write_keys(tmp_path, keys)
+    r = run_minimpi(minimpi_binaries["radix"], [p], 4, timeout=60,
+                    env_extra={"COMM_FAULTS": "kill:2@4"})
+    assert r.returncode == 43, (r.returncode, r.stderr)
+    assert "[FAULT] rank 2 killed" in r.stderr
+
+
+def test_comm_faults_stall_minimpi_correct(minimpi_binaries, tmp_path, rng):
+    keys = rng.integers(-(2**31), 2**31 - 1, size=10_000, dtype=np.int32)
+    p = write_keys(tmp_path, keys)
+    r = run_minimpi(minimpi_binaries["radix"], [p], 4, timeout=120,
+                    env_extra={"COMM_FAULTS": "stall:3@1:40"})
+    assert r.returncode == 0, r.stderr
+    ref = np.sort(keys)
+    assert f"The n/2-th sorted element: {ref[4999]}" in r.stdout
+
+
+@pytest.mark.parametrize("bad", ["garbage", "kill:1", "stall:1@2",
+                                 "kill:-1@3", "kill:1@3:50",
+                                 "stall:1@2:50x"])
+def test_comm_faults_bad_spec_fails_launch(bad, binaries, tmp_path, rng):
+    """A typo'd drill spec must fail the launch loudly — a chaos drill
+    that silently runs clean reports false health."""
+    keys = rng.integers(-100, 100, size=100, dtype=np.int32)
+    p = write_keys(tmp_path, keys)
+    r = run_native(binaries["radix"], p, ranks=2,
+                   env={"COMM_FAULTS": bad})
+    assert r.returncode != 0
+    assert "COMM_FAULTS" in r.stderr
+
+
 def test_minimpi_abort_contract(minimpi_binaries):
     """MPI_Abort terminates ALL ranks with the abort code (mpirun
     contract) — no hang, no signal-exit rewrite."""

@@ -507,6 +507,49 @@ def test_staged_single_use_under_donation(mesh8, rng, monkeypatch):
                                   np.sort(x))
 
 
+@pytest.mark.parametrize("algo", ALGOS)
+@pytest.mark.parametrize("donate", ["0", "1"])
+def test_forced_tiny_cap_overflow_retry(algo, donate, mesh8, rng,
+                                        monkeypatch):
+    """ISSUE 3 satellite: SORT_CAP_FACTOR ≈ 0 forces the first exchange
+    cap to the alignment floor for BOTH algorithms — the overflow-retry
+    path (now the supervisor's ONE shared cap-regrow loop) must recover
+    exact bytes, with and without buffer donation (the donated variant
+    exercises the PR 2 re-stage path: the failed dispatch consumed the
+    input words)."""
+    monkeypatch.setenv("SORT_DONATE", donate)
+    from mpitest_tpu.utils.trace import Tracer
+
+    x = rng.integers(-(2**31), 2**31 - 1, size=50_000, dtype=np.int32)
+    tr = Tracer()
+    got = sort(x, algorithm=algo, mesh=mesh8, cap_factor=1e-9, tracer=tr)
+    np.testing.assert_array_equal(got, np.sort(x))
+    assert (tr.counters.get("exchange_retries", 0) >= 1
+            or tr.counters.get("sample_skew_fallback", 0) >= 1), tr.counters
+    # the run must also have passed its own verification
+    assert tr.counters.get("verify_runs", 0) >= 1
+    assert tr.counters.get("verify_failures", 0) == 0
+
+
+def test_tiny_cap_retry_with_staged_donated_ingest(mesh8, rng, monkeypatch):
+    """Tiny cap + donation + streamed StagedIngest input: the overflow
+    retry must re-stream from the staged source (PR 2's donated-buffer
+    re-stage) and still verify."""
+    monkeypatch.setenv("SORT_DONATE", "1")
+    monkeypatch.setenv("SORT_INGEST", "stream")
+    monkeypatch.setenv("SORT_INGEST_CHUNK", "8192")
+    from mpitest_tpu.models.api import ingest_to_mesh
+    from mpitest_tpu.utils.trace import Tracer
+
+    x = rng.integers(-(2**31), 2**31 - 1, size=50_000, dtype=np.int32)
+    st = ingest_to_mesh(x, mesh=mesh8)
+    tr = Tracer()
+    got = sort(st, algorithm="radix", cap_factor=1e-9, tracer=tr)
+    np.testing.assert_array_equal(got, np.sort(x))
+    assert tr.counters.get("exchange_retries", 0) >= 1
+    assert tr.counters.get("verify_failures", 0) == 0
+
+
 def test_streamed_egress_matches_legacy(mesh8, rng, monkeypatch):
     """Streamed egress (decode overlapping shard fetches) returns the
     same bytes as the legacy whole-result gather."""

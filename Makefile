@@ -27,12 +27,32 @@
 #                  --require-ingest-overlap` then asserts the emitted
 #                  ingest.* spans show parse/encode genuinely
 #                  overlapping the host→device transfers
+#   make lint    — static analysis (ISSUE 4): sortlint (the project's
+#                  custom AST rules — env-knob registry, span schema,
+#                  SPMD safety, fault coverage, typed core), the
+#                  cross-backend comm parity checker, a
+#                  -Wconversion/-Wshadow -Werror pass over every C
+#                  source, and mypy strict on the typed core / a
+#                  clang-tidy pass where those tools are installed
+#                  (CI's lint job installs mypy; this image ships
+#                  neither).  No JAX device needed.
+#   make sanitize-selftest — the native sanitizer matrix: TSan on the
+#                  pthreads backend (comm_selftest + seeded comm_fuzz —
+#                  a real race detector over the barrier/alltoallv
+#                  paths), ASan+UBSan on BOTH backends (pthreads and
+#                  the fork-based minimpi runtime), with a
+#                  cross-sanitizer checksum differential and an
+#                  empty-by-policy suppressions file
+#                  (tools/sanitize.supp).
+#   make knob-docs — regenerate README's env-knob reference table from
+#                  the central registry (mpitest_tpu/utils/knobs.py)
 #   make clean   — remove all build artifacts
 
 PYTHON ?= python3
 
 .PHONY: test native chip-test telemetry-selftest ingest-selftest \
-    fault-selftest clean
+    fault-selftest lint cwarn-check typecheck tidy-check knob-docs \
+    sanitize-selftest clean
 
 chip-test:
 	$(PYTHON) -u bench/chip_regression.py
@@ -63,7 +83,7 @@ telemetry-selftest:
 	    $(PYTHON) drivers/sort_cli.py $(TELEMETRY_TMP)/keys.txt
 	COMM_RANKS=4 COMM_STATS=$(TELEMETRY_TMP)/comm_stats.jsonl \
 	    mpi_radix_sort/radix_sort $(TELEMETRY_TMP)/keys.txt
-	$(PYTHON) -m mpitest_tpu.report --check \
+	$(PYTHON) -m mpitest_tpu.report --check --require-registered-spans \
 	    $(TELEMETRY_TMP)/trace.jsonl $(TELEMETRY_TMP)/comm_stats.jsonl
 	$(PYTHON) -m mpitest_tpu.report \
 	    $(TELEMETRY_TMP)/trace.jsonl $(TELEMETRY_TMP)/comm_stats.jsonl
@@ -99,7 +119,116 @@ ingest-selftest:
 	$(PYTHON) -m mpitest_tpu.report --check --require-ingest-overlap \
 	    $(INGEST_TMP)/trace.jsonl
 
+# ---------------------------------------------------------------- lint
+# The static-analysis gate (ISSUE 4).  Always-on legs: sortlint, the
+# comm parity checker, and the C warning gate (gcc is in every image).
+# mypy / clang-tidy legs run when installed and report a loud SKIP
+# otherwise — never a silent pass of a gate that did not run.
+lint: cwarn-check
+	$(PYTHON) -m tools.sortlint
+	$(PYTHON) tools/comm_parity.py
+	$(MAKE) typecheck tidy-check
+
+#: Every C source must compile warning-free under the strict set.  The
+#: two MPI-linked files typecheck against the vendored stub header.
+CWARN := -O2 -std=c11 -Wall -Wextra -Wconversion -Wshadow -Werror \
+    -fsyntax-only
+cwarn-check:
+	$(CC) $(CWARN) -Icomm comm/comm_local.c
+	$(CC) $(CWARN) -Icomm -Icomm/mpi_stub comm/comm_mpi.c
+	$(CC) $(CWARN) -Icomm -Icomm/mpi_stub comm/mpi_stub/mpi_mock.c
+	$(CC) $(CWARN) -Icomm -Icomm/mpi_stub comm/mpi_stub/minimpi.c
+	$(CC) $(CWARN) -Icomm -Inative native/sample_sort.c
+	$(CC) $(CWARN) -Icomm -Inative native/radix_sort.c
+	$(CC) $(CWARN) -Icomm native/comm_selftest.c
+	$(CC) $(CWARN) -Icomm native/comm_bench.c
+	$(CC) $(CWARN) -Icomm native/comm_fuzz.c
+	$(CC) $(CWARN) -Icomm/mpi_stub native/minimpi_earlyexit.c
+	@echo "cwarn-check OK (-Wconversion -Wshadow -Werror clean)"
+
+typecheck:
+	@if $(PYTHON) -c "import mypy" 2>/dev/null; then \
+	    $(PYTHON) -m mypy --config-file pyproject.toml && \
+	    echo "mypy strict OK (typed core)"; \
+	else \
+	    echo "SKIP: mypy not installed (CI lint job runs it;" \
+	         "sortlint SL040 enforces annotation completeness here)"; \
+	fi
+
+tidy-check:
+	@if command -v clang-tidy >/dev/null 2>&1; then \
+	    clang-tidy --quiet comm/comm_local.c native/sample_sort.c \
+	        native/radix_sort.c -- -Icomm -Inative -std=c11 && \
+	    echo "clang-tidy OK"; \
+	else \
+	    echo "SKIP: clang-tidy not installed (cwarn-check is the" \
+	         "always-on C gate)"; \
+	fi
+
+knob-docs:
+	$(PYTHON) tools/gen_knob_docs.py
+
+# ---------------------------------------------------- sanitize-selftest
+# The runtime half of the gate: build + RUN the comm selftest and a
+# seeded, bounded fuzz run under each sanitizer.  Same seed must fold to
+# the same checksum across sanitizer builds and backends (any divergence
+# means a sanitizer-visible bug altered behavior).  bench/Makefile's
+# build stamp rebuilds on SANITIZE changes for the BACKEND targets; the
+# minimpi binaries are removed explicitly (they carry no stamp).
+SAN_SEEDS := 1 42 1234
+SAN_SUPP  := $(CURDIR)/tools/sanitize.supp
+# checkout-scoped staging for the differential (NOT a shared /tmp path:
+# a concurrent run in another checkout must not interleave with ours)
+SAN_OUT   := $(CURDIR)/bench/.san-out
+sanitize-selftest:
+	@echo "== TSan: pthreads backend (race detector) =="
+	mkdir -p $(SAN_OUT)
+	$(MAKE) -C bench SANITIZE=thread BACKEND=local comm_selftest comm_fuzz
+	TSAN_OPTIONS="suppressions=$(SAN_SUPP)" COMM_RANKS=4 ./bench/comm_selftest
+	TSAN_OPTIONS="suppressions=$(SAN_SUPP)" COMM_RANKS=8 ./bench/comm_selftest
+	# NOTE: fuzz output goes to a file, never through a pipe — `| tee`
+	# would take tee's exit status and mask a sanitizer's nonzero exit,
+	# which is the one signal this gate exists to propagate.
+	for s in $(SAN_SEEDS); do \
+	    TSAN_OPTIONS="suppressions=$(SAN_SUPP)" COMM_RANKS=5 \
+	        ./bench/comm_fuzz $$s 200 > $(SAN_OUT)/tsan_$$s || exit 1; \
+	    cat $(SAN_OUT)/tsan_$$s; \
+	done
+	@echo "== ASan+UBSan: pthreads backend =="
+	$(MAKE) -C bench SANITIZE=address,undefined BACKEND=local \
+	    comm_selftest comm_fuzz
+	ASAN_OPTIONS="suppressions=$(SAN_SUPP)" COMM_RANKS=4 ./bench/comm_selftest
+	for s in $(SAN_SEEDS); do \
+	    ASAN_OPTIONS="suppressions=$(SAN_SUPP)" COMM_RANKS=5 \
+	        ./bench/comm_fuzz $$s 200 > $(SAN_OUT)/asan_$$s || exit 1; \
+	    cat $(SAN_OUT)/asan_$$s; \
+	done
+	@echo "== ASan+UBSan: MPI backend over the fork-based minimpi runtime =="
+	rm -f bench/comm_selftest_minimpi bench/comm_fuzz_minimpi
+	$(MAKE) -C bench SANITIZE=address,undefined \
+	    comm_selftest_minimpi comm_fuzz_minimpi
+	ASAN_OPTIONS="suppressions=$(SAN_SUPP)" MINIMPI_NP=4 \
+	    ./bench/comm_selftest_minimpi
+	for s in $(SAN_SEEDS); do \
+	    ASAN_OPTIONS="suppressions=$(SAN_SUPP)" MINIMPI_NP=5 \
+	        ./bench/comm_fuzz_minimpi $$s 200 \
+	        > $(SAN_OUT)/minimpi_$$s || exit 1; \
+	    cat $(SAN_OUT)/minimpi_$$s; \
+	done
+	@echo "== cross-sanitizer / cross-backend checksum differential =="
+	for s in $(SAN_SEEDS); do \
+	    cmp $(SAN_OUT)/tsan_$$s $(SAN_OUT)/asan_$$s || exit 1; \
+	    a=$$(grep -o 'checksum=.*' $(SAN_OUT)/asan_$$s); \
+	    b=$$(grep -o 'checksum=.*' $(SAN_OUT)/minimpi_$$s); \
+	    test "$$a" = "$$b" || { echo "checksum mismatch seed $$s"; exit 1; }; \
+	done
+	rm -f bench/comm_selftest_minimpi bench/comm_fuzz_minimpi
+	$(MAKE) -C bench BACKEND=local  # restore unsanitized default builds
+	@echo "sanitize-selftest OK (TSan + ASan/UBSan x both backends," \
+	    "suppressions file empty)"
+
 clean:
 	$(MAKE) -C mpi_sample_sort clean
 	$(MAKE) -C mpi_radix_sort clean
 	$(MAKE) -C bench clean
+	rm -rf $(SAN_OUT)

@@ -53,6 +53,32 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def tooling_state() -> dict:
+    """The lint/sanitizer gate state stamped into every bench row, so a
+    BENCH number is attributable to the tooling that was in force when
+    it was measured (ISSUE 4): sortlint version + rule count, the C
+    warning flags, the sanitizer matrix, and the mypy version when the
+    strict gate could run (None = gate skipped on this image)."""
+    t: dict = {
+        "cwarn": "-Wconversion -Wshadow -Werror",
+        "sanitize": "tsan:local asan,ubsan:local+minimpi",
+    }
+    try:
+        from tools.sortlint import LINT_VERSION, RULES
+
+        t["sortlint"] = LINT_VERSION
+        t["sortlint_rules"] = len(RULES)
+    except Exception as e:  # tools/ not importable: record why, loudly
+        t["sortlint"] = f"unavailable ({type(e).__name__})"
+    try:
+        from mypy.version import __version__ as mypy_version
+
+        t["mypy"] = mypy_version
+    except Exception:
+        t["mypy"] = None
+    return t
+
+
 def encoded_median(x_or_scalar, dtype: np.dtype) -> int:
     """Collapse key(s) to one comparable integer for the median probe:
     the native value for ints; the encoded totalOrder bit pattern for
@@ -157,16 +183,22 @@ def measure_native(x: np.ndarray, algo: str, ranks: int,
 def main() -> None:
     # BENCH_PLATFORM=cpu[:N] forces an N-device virtual CPU mesh (for
     # TPU-less CI of the bench contract) via the one shared recipe —
-    # must land before the first backend query.
-    plat = os.environ.get("BENCH_PLATFORM")
-    if plat:
+    # must land before the first backend query.  The knob registry
+    # parses cpu[:N] to the device count (garbage raises KnobError).
+    from mpitest_tpu.utils import knobs
+
+    try:
+        ndev = knobs.get("BENCH_PLATFORM")
+        dtype = np.dtype(knobs.get("BENCH_DTYPE"))
+        knobs.validate("BENCH_LOG2N", "BENCH_ALGO", "BENCH_REPEATS",
+                       "BENCH_NATIVE_RANKS", "BENCH_NATIVE_REPEATS")
+    except ValueError as e:
+        # the pre-registry contract: one clean line, never a traceback
+        raise SystemExit(str(e)) from None
+    if ndev:
         from mpitest_tpu.utils.platform import ensure_virtual_cpu_devices
 
-        name, _, ndev = plat.partition(":")
-        if name != "cpu":
-            raise SystemExit(f"BENCH_PLATFORM supports cpu[:N], got {plat!r}")
-        ensure_virtual_cpu_devices(int(ndev) if ndev else 1)
-    dtype = np.dtype(os.environ.get("BENCH_DTYPE", "int32"))
+        ensure_virtual_cpu_devices(ndev)
     import jax
 
     if dtype.itemsize == 8:
@@ -193,10 +225,10 @@ def main() -> None:
 
     platform = jax.devices()[0].platform
     on_tpu = platform not in ("cpu",)
-    log2n = int(os.environ.get("BENCH_LOG2N", "28" if on_tpu else "20"))
-    algo = os.environ.get("BENCH_ALGO", "radix")
-    repeats = int(os.environ.get("BENCH_REPEATS", "3"))
-    native_ranks = int(os.environ.get("BENCH_NATIVE_RANKS", "8"))
+    log2n = knobs.get("BENCH_LOG2N") or (28 if on_tpu else 20)
+    algo = knobs.get("BENCH_ALGO")
+    repeats = knobs.get("BENCH_REPEATS")
+    native_ranks = knobs.get("BENCH_NATIVE_RANKS")
     n = 1 << log2n
 
     log(f"bench: platform={platform} devices={len(jax.devices())} "
@@ -251,7 +283,8 @@ def main() -> None:
     if not ok:
         log("CORRECTNESS FAILURE — reporting value 0")
         print(json.dumps({"metric": metric_name, "value": 0.0,
-                          "unit": "Mkeys/s", "vs_baseline": 0.0}))
+                          "unit": "Mkeys/s", "vs_baseline": 0.0,
+                          "tooling": tooling_state()}))
         return
 
     metrics = Metrics(config={"platform": platform, "algo": algo,
@@ -294,7 +327,7 @@ def main() -> None:
     # host-CPU MPI"; the pthreads backend is the same shared-memory
     # transport class mpirun uses on one host).
     vs_native = None
-    native_repeats = int(os.environ.get("BENCH_NATIVE_REPEATS", "3"))
+    native_repeats = knobs.get("BENCH_NATIVE_REPEATS")
     native_repeats_used = None
     if native_ranks > 0:
         native_s, native_repeats_used = measure_native(
@@ -411,6 +444,7 @@ def main() -> None:
         "retries": retries,
         "faults_injected": faults_injected,
         "verify_overhead_s": verify_s,
+        "tooling": tooling_state(),
     }
     if vs_canonical is not None:
         out["vs_canonical_native"] = round(vs_canonical, 3)

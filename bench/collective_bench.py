@@ -30,8 +30,10 @@ def main() -> None:
     if args.cpu:
         import os
 
+        from mpitest_tpu.utils import knobs
+
         os.environ["XLA_FLAGS"] = (
-            os.environ.get("XLA_FLAGS", "")
+            knobs.get("XLA_FLAGS")
             + " --xla_force_host_platform_device_count=8"
         )
     import jax
@@ -62,7 +64,9 @@ def main() -> None:
     x = jnp.arange(n_ranks * n_ranks * lanes, dtype=jnp.uint32).reshape(
         n_ranks * n_ranks, lanes
     )
-    x = jax.device_put(x, jax.sharding.NamedSharding(mesh, P(AXIS)))
+    from mpitest_tpu.models.ingest import checked_device_put
+
+    x = checked_device_put(x, jax.sharding.NamedSharding(mesh, P(AXIS)))
 
     out = fn(x)  # compile + warm
     int(jax.device_get(out[-1, -1]))

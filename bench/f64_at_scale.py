@@ -40,11 +40,14 @@ def main() -> int:
         print("f64_at_scale: needs a real TPU", flush=True)
         return 2
 
+    from mpitest_tpu.models.ingest import checked_device_put
     from mpitest_tpu.ops import kernels
     from mpitest_tpu.ops.keys import codec_for
 
-    log2n = int(os.environ.get("F64_LOG2N", "27"))
-    repeats = int(os.environ.get("F64_REPEATS", "2"))
+    from mpitest_tpu.utils import knobs
+
+    log2n = knobs.get("F64_LOG2N")
+    repeats = knobs.get("F64_REPEATS")
     n = 1 << log2n
     rng = np.random.default_rng(3)
     # Wide-dynamic-range doubles incl. the totalOrder edge cases.
@@ -61,8 +64,8 @@ def main() -> int:
     ref_median = int(np.partition(enc64, n // 2 - 1)[n // 2 - 1])
 
     t0 = time.perf_counter()
-    hi = jax.device_put(jnp.asarray(hi_np))
-    lo = jax.device_put(jnp.asarray(lo_np))
+    hi = checked_device_put(jnp.asarray(hi_np), jax.devices()[0])
+    lo = checked_device_put(jnp.asarray(lo_np), jax.devices()[0])
     jax.device_get(hi[-1:]), jax.device_get(lo[-1:])
     ingest_s = time.perf_counter() - t0
     print(f"host encode {enc_s:.2f}s; ingest {ingest_s:.1f}s "

@@ -45,6 +45,7 @@ from mpitest_tpu import faults  # noqa: E402
 from mpitest_tpu.models.api import (  # noqa: E402
     SortIntegrityError, SortRetryExhausted, sort)
 from mpitest_tpu.parallel.mesh import make_mesh  # noqa: E402
+from mpitest_tpu.utils import knobs  # noqa: E402
 from mpitest_tpu.utils.trace import Tracer  # noqa: E402
 
 PASS, FAIL = "recovered", "FAILED"
@@ -75,13 +76,12 @@ def main() -> int:
                 # the poison hook lives in the streamed ingest pipeline
                 env_extra = {"SORT_INGEST": "stream",
                              "SORT_INGEST_CHUNK": "4096"}
-            old = {k: os.environ.get(k) for k in env_extra}
-            os.environ.update(env_extra)
             reg = faults.FaultRegistry(site, seed=7)
             faults.install(reg)
             tr = Tracer()
             try:
-                got = sort(x, algorithm=algo, mesh=mesh, tracer=tr)
+                with knobs.scoped_env(**env_extra):
+                    got = sort(x, algorithm=algo, mesh=mesh, tracer=tr)
                 exact = bool(np.array_equal(got, ref))
                 fired = reg.injected > 0
                 detail = (f"faults={reg.injected} "
@@ -97,9 +97,6 @@ def main() -> int:
                      f"typed error on a transient fault: {type(e).__name__}")
             finally:
                 faults.install(None)
-                for k, v in old.items():
-                    os.environ.pop(k, None) if v is None else \
-                        os.environ.__setitem__(k, v)
 
     print("persistent faults: recover via ladder OR fail typed")
     for spec, fallback, expect in (
@@ -108,13 +105,13 @@ def main() -> int:
         ("result_dup:inf", "0", "integrityerr"),  # typed integrity error
     ):
         for algo in ("radix", "sample"):
-            os.environ["SORT_FALLBACK"] = fallback
             reg = faults.FaultRegistry(spec, seed=7)
             faults.install(reg)
             tr = Tracer()
             name = f"{spec} fallback={fallback} x {algo}"
             try:
-                got = sort(x, algorithm=algo, mesh=mesh, tracer=tr)
+                with knobs.scoped_env(SORT_FALLBACK=fallback):
+                    got = sort(x, algorithm=algo, mesh=mesh, tracer=tr)
                 ok = (expect == "host"
                       and np.array_equal(got, ref)
                       and tr.counters.get("degraded_to") == "host")
@@ -126,7 +123,6 @@ def main() -> int:
                 cell(name, expect == "integrityerr", "SortIntegrityError")
             finally:
                 faults.install(None)
-                os.environ.pop("SORT_FALLBACK", None)
 
     print("CLI exit codes: typed errors -> distinct nonzero exits")
     keyfile = "/tmp/fault_selftest_keys.txt"

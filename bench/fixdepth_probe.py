@@ -42,7 +42,9 @@ def main() -> int:
 
     from mpitest_tpu.ops import kernels
 
-    parts = os.environ.get("FIX_PARTS", "uniform,runs16,exact").split(",")
+    from mpitest_tpu.utils import knobs
+
+    parts = knobs.get("FIX_PARTS")
     n = 1 << 26
     rng = np.random.default_rng(11)
     row: dict = {"ts": time.time(), "config": "fixdepth_probe_2e26"}

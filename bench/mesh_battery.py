@@ -28,7 +28,6 @@ this is the at-scale artifact.  Resumable:
 from __future__ import annotations
 
 import json
-import os
 import sys
 import time
 from pathlib import Path
@@ -52,8 +51,10 @@ def main() -> int:
     from mpitest_tpu.utils.io import generate_zipf
     from mpitest_tpu.utils.trace import Tracer
 
-    parts = os.environ.get("MESHB_PARTS", "dtypes,zipf,pack,engines").split(",")
-    log2n = int(os.environ.get("MESHB_LOG2N", "21"))
+    from mpitest_tpu.utils import knobs
+
+    parts = knobs.get("MESHB_PARTS")
+    log2n = knobs.get("MESHB_LOG2N")
     n = (1 << log2n) + 1371  # non-divisible by 8: exercises padding
     mesh = make_mesh(8)
     rng = np.random.default_rng(17)
@@ -115,19 +116,19 @@ def main() -> int:
         bitonic.MIN_SORT_LOG2 = 8
         bitonic.BLOCK_LOG2 = 10
         bitonic.PAIR_BLOCK_LOG2 = 10
-        os.environ["SORT_LOCAL_ENGINE"] = "bitonic"
         try:
-            x32 = rng.integers(-(2**31), 2**31 - 1, size=n, dtype=np.int32)
-            e1, _ = check("engine bitonic-1w sample int32 shard_map",
-                          x32, "sample")
-            x64 = rng.integers(-(2**62), 2**62, size=n, dtype=np.int64)
-            e2, _ = check("engine bitonic-pair sample int64 shard_map",
-                          x64, "sample")
-            row["engine_1w_ok"], row["engine_pair_ok"] = e1, e2
+            with knobs.scoped_env(SORT_LOCAL_ENGINE="bitonic"):
+                x32 = rng.integers(-(2**31), 2**31 - 1, size=n,
+                                   dtype=np.int32)
+                e1, _ = check("engine bitonic-1w sample int32 shard_map",
+                              x32, "sample")
+                x64 = rng.integers(-(2**62), 2**62, size=n, dtype=np.int64)
+                e2, _ = check("engine bitonic-pair sample int64 shard_map",
+                              x64, "sample")
+                row["engine_1w_ok"], row["engine_pair_ok"] = e1, e2
         finally:
             (bitonic.MIN_SORT_LOG2, bitonic.BLOCK_LOG2,
              bitonic.PAIR_BLOCK_LOG2) = saved
-            del os.environ["SORT_LOCAL_ENGINE"]
 
     row["all_ok"] = ok_all
     with open(RESULTS, "a") as f:

@@ -45,8 +45,10 @@ def main() -> int:
 
     from mpitest_tpu.ops import bitonic, kernels
 
-    log2n = int(os.environ.get("PROBE_LOG2N", "26"))
-    parts = os.environ.get("PROBE_PARTS", "agree,net,1w,full").split(",")
+    from mpitest_tpu.utils import knobs
+
+    log2n = knobs.get("PROBE_LOG2N")
+    parts = knobs.get("PROBE_PARTS")
     n = 1 << log2n
     rng = np.random.default_rng(7)
     k = jnp.asarray(rng.integers(0, 2**32, n, dtype=np.uint64)
@@ -61,7 +63,8 @@ def main() -> int:
         """Order-invariant, pairing-sensitive: mixes each pair before
         the commutative reduces."""
         m = (kk * jnp.uint32(2654435761)) ^ pp
-        x = jax.lax.reduce(m, jnp.uint32(0), jax.lax.bitwise_xor, (0,))
+        x = jax.lax.reduce(  # sortlint: disable=SL010 -- single-device jit checksum, no SPMD partitioner
+            m, jnp.uint32(0), jax.lax.bitwise_xor, (0,))
         return m.sum(), x
 
     if "agree" in parts:

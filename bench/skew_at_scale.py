@@ -60,21 +60,23 @@ def chip_rows() -> int:
     import jax
 
     jax.config.update("jax_enable_x64", True)  # device-resident int64
-    from mpitest_tpu.models.api import sort
+    from mpitest_tpu.models.api import checked_device_put, sort
     from mpitest_tpu.parallel.mesh import make_mesh
     from mpitest_tpu.utils.trace import Tracer
 
     if jax.default_backend() == "cpu":
         print("skew_at_scale --chip: no TPU attached", flush=True)
         return 2
-    log2n = int(os.environ.get("SKEW_LOG2N", "27"))
-    repeats = int(os.environ.get("SKEW_REPEATS", "2"))
+    from mpitest_tpu.utils import knobs
+
+    log2n = knobs.get("SKEW_LOG2N")
+    repeats = knobs.get("SKEW_REPEATS")
     # Resumability (verify skill: budget chip jobs <= ~9 min): a degraded
     # tunnel can eat a whole budget on one 2 GiB ingest — SKEW_DISTS
     # selects a subset so a timed-out sweep continues where it stopped
     # (completed rows are already appended).
-    only = os.environ.get("SKEW_DISTS")
-    sel = set(only.split(",")) if only else None
+    only = knobs.get("SKEW_DISTS")
+    sel = set(only) if only else None
     n = 1 << log2n
     mesh = make_mesh()
     for name, gen in _dists(n).items():
@@ -86,7 +88,7 @@ def chip_rows() -> int:
         print(f"{name} 2^{log2n}: ingesting {x.nbytes >> 20} MiB "
               "(tunnel-speed dependent; see verify skill)", flush=True)
         t0 = time.perf_counter()
-        x_dev = jax.device_put(x, mesh.devices.flat[0])
+        x_dev = checked_device_put(x, mesh.devices.flat[0])
         x_dev.block_until_ready()
         jax.device_get(x_dev[-1:])  # the transfer is lazy until synced
         print(f"{name} 2^{log2n}: ingest {time.perf_counter() - t0:.1f}s",
@@ -133,11 +135,13 @@ def mesh_counters() -> int:
     import jax
 
     jax.config.update("jax_enable_x64", True)
-    from mpitest_tpu.models.api import sort
+    from mpitest_tpu.models.api import checked_device_put, sort
     from mpitest_tpu.parallel.mesh import make_mesh
     from mpitest_tpu.utils.trace import Tracer
 
-    log2n = int(os.environ.get("SKEW_MESH_LOG2N", "24"))
+    from mpitest_tpu.utils import knobs
+
+    log2n = knobs.get("SKEW_MESH_LOG2N")
     n = 1 << log2n
     mesh = make_mesh(8)
     expect = {"zipf11": 0, "zipf15": 1}  # sample_skew_fallback per dist
@@ -146,7 +150,7 @@ def mesh_counters() -> int:
         if name not in expect:
             continue
         x = gen()
-        x_dev = jax.device_put(x, jax.devices()[0])  # device-resident input
+        x_dev = checked_device_put(x, jax.devices()[0])  # device-resident input
         tracer = Tracer()
         t0 = time.perf_counter()
         got = sort(x_dev, algorithm="sample", mesh=mesh, tracer=tracer)

@@ -49,7 +49,7 @@ def adversarial_patterns_at_scale(log2n: int = 28) -> None:
         out = bitonic.sort_padded(v, n, bitonic.BLOCK_LOG2)
         is_sorted = jnp.all(out[1:] >= out[:-1])
         sum_ok = v.sum() == out.sum()
-        xor = lambda a: jax.lax.reduce(a, jnp.uint32(0),
+        xor = lambda a: jax.lax.reduce(a, jnp.uint32(0),  # sortlint: disable=SL010 -- single-device jit checksum, no SPMD partitioner
                                        jax.lax.bitwise_xor, (0,))
         return is_sorted, sum_ok, xor(v) == xor(out)
 
@@ -89,10 +89,13 @@ def adversarial_patterns_64(log2n: int = 26) -> None:
     import jax.numpy as jnp
 
     jax.config.update("jax_enable_x64", True)
+    from mpitest_tpu.models.api import checked_device_put
     from mpitest_tpu.ops.keys import codec_for
     from mpitest_tpu.utils.trace import Tracer
 
-    log2n = int(os.environ.get("STRESS64_LOG2N", str(log2n)))
+    from mpitest_tpu.utils import knobs
+
+    log2n = knobs.get("STRESS64_LOG2N") or log2n
     n = 1 << log2n
     r = np.random.default_rng(5)
     codec = codec_for(np.int64)
@@ -140,15 +143,15 @@ def adversarial_patterns_64(log2n: int = 26) -> None:
         # collision may reroute up front — both are correct routes
         "mid-runs24": (runs_of(24), {"bitonic_pair+lax_fallback", "lax"}),
     }
-    only = os.environ.get("STRESS64_PATTERNS")
-    sel = set(only.split(",")) if only else None
+    only = knobs.get("STRESS64_PATTERNS")
+    sel = set(only) if only else None
 
     @jax.jit
     def check(x, hi_o, lo_o):
         hi_i, lo_i = codec.encode_jax(x)
         asc = (hi_o[1:] > hi_o[:-1]) | ((hi_o[1:] == hi_o[:-1])
                                         & (lo_o[1:] >= lo_o[:-1]))
-        xor = lambda a: jax.lax.reduce(a, jnp.uint32(0),
+        xor = lambda a: jax.lax.reduce(a, jnp.uint32(0),  # sortlint: disable=SL010 -- single-device jit checksum, no SPMD partitioner
                                        jax.lax.bitwise_xor, (0,))
         return (jnp.all(asc),
                 (hi_i.sum() == hi_o.sum()) & (lo_i.sum() == lo_o.sum()),
@@ -158,7 +161,7 @@ def adversarial_patterns_64(log2n: int = 26) -> None:
         if sel is not None and name not in sel:
             continue
         x = gen()
-        dev = jax.device_put(x, jax.devices()[0])
+        dev = checked_device_put(x, jax.devices()[0])
         jax.device_get(dev[-1:])  # materialize the (lazy) ingest
         tracer = Tracer()
         res = mpitest_tpu.sort(dev, algorithm="radix", return_result=True,

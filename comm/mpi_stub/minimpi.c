@@ -358,7 +358,7 @@ int MPI_Scatter(const void *sendbuf, int sendcount, MPI_Datatype st,
                      : (size_t)recvcount * (size_t)rt->size,
         "MPI_Scatter");
     size_t step = slice_chunk(bytes);
-    if (bytes && !step) need(bytes * NP, "MPI_Scatter");
+    if (bytes && !step) need(bytes * (size_t)NP, "MPI_Scatter");
     for (size_t off = 0; off < bytes || off == 0; ) {
         size_t c = bytes - off < step ? bytes - off : step;
         if (RANK == root && c)
@@ -390,7 +390,7 @@ int MPI_Gather(const void *sendbuf, int sendcount, MPI_Datatype st,
         MPI_Abort(MPI_COMM_WORLD, 1);
     }
     size_t step = slice_chunk(bytes);
-    if (bytes && !step) need(bytes * NP, "MPI_Gather");
+    if (bytes && !step) need(bytes * (size_t)NP, "MPI_Gather");
     for (size_t off = 0; off < bytes || off == 0; ) {
         size_t c = bytes - off < step ? bytes - off : step;
         if (c) memcpy(STG + (size_t)RANK * c, (const char *)sendbuf + off, c);
@@ -415,7 +415,7 @@ int MPI_Allgather(const void *sendbuf, int sendcount, MPI_Datatype st,
     size_t bytes = published_bytes(
         0, (size_t)sendcount * (size_t)st->size, "MPI_Allgather");
     size_t step = slice_chunk(bytes);
-    if (bytes && !step) need(bytes * NP, "MPI_Allgather");
+    if (bytes && !step) need(bytes * (size_t)NP, "MPI_Allgather");
     for (size_t off = 0; off < bytes || off == 0; ) {
         size_t c = bytes - off < step ? bytes - off : step;
         if (c) memcpy(STG + (size_t)RANK * c, (const char *)sendbuf + off, c);
@@ -439,18 +439,18 @@ int MPI_Alltoall(const void *sendbuf, int sendcount, MPI_Datatype st,
         0, (size_t)sendcount * (size_t)st->size, "MPI_Alltoall");
     size_t per = H->staging_cap / ((size_t)NP * (size_t)NP);
     size_t step = bytes < per ? bytes : per;
-    if (bytes && !step) need(bytes * NP * NP, "MPI_Alltoall");
+    if (bytes && !step) need(bytes * (size_t)NP * (size_t)NP, "MPI_Alltoall");
     for (size_t off = 0; off < bytes || off == 0; ) {
         size_t c = bytes - off < step ? bytes - off : step;
         if (c)
             for (int j = 0; j < NP; j++)
-                memcpy(STG + ((size_t)RANK * NP + (size_t)j) * c,
+                memcpy(STG + ((size_t)RANK * (size_t)NP + (size_t)j) * c,
                        (const char *)sendbuf + (size_t)j * bytes + off, c);
         bar();
         if (c)
             for (int i = 0; i < NP; i++)
                 memcpy((char *)recvbuf + (size_t)i * bytes + off,
-                       STG + ((size_t)i * NP + (size_t)RANK) * c, c);
+                       STG + ((size_t)i * (size_t)NP + (size_t)RANK) * c, c);
         bar();
         off += c;
         if (c == 0) break;
@@ -500,7 +500,7 @@ int MPI_Scatterv(const void *sendbuf, const int *sendcounts,
         if (RANK == root) {
             size_t off = 0;
             for (int i = 0; i < NP; i++) {
-                seg_window((char *)sendbuf + (size_t)displs[i] * st->size,
+                seg_window((char *)sendbuf + (size_t)displs[i] * (size_t)st->size,
                            off, H->counts[i], w, wlen, 1);
                 off += H->counts[i];
             }
@@ -532,7 +532,7 @@ int MPI_Gatherv(const void *sendbuf, int sendcount, MPI_Datatype st,
         if (RANK == root) {
             size_t off = 0;
             for (int i = 0; i < NP; i++) {
-                seg_window((char *)recvbuf + (size_t)displs[i] * rt->size,
+                seg_window((char *)recvbuf + (size_t)displs[i] * (size_t)rt->size,
                            off, H->counts[i], w, wlen, 0);
                 off += H->counts[i];
             }
@@ -549,7 +549,7 @@ int MPI_Alltoallv(const void *sendbuf, const int *sendcounts,
                   MPI_Datatype rt, MPI_Comm comm) {
     (void)recvcounts; (void)comm;
     for (int j = 0; j < NP; j++)
-        H->counts[(size_t)RANK * NP + j] =
+        H->counts[(size_t)RANK * (size_t)NP + (size_t)j] =
             (size_t)sendcounts[j] * (size_t)st->size;
     bar();
     /* row-major exclusive prefix over the published [NP,NP] count matrix
@@ -562,9 +562,9 @@ int MPI_Alltoallv(const void *sendbuf, const int *sendcounts,
         size_t off = 0;
         for (int i = 0; i < NP; i++)
             for (int j = 0; j < NP; j++) {
-                size_t c = H->counts[(size_t)i * NP + j];
+                size_t c = H->counts[(size_t)i * (size_t)NP + (size_t)j];
                 if (i == RANK)
-                    seg_window((char *)sendbuf + (size_t)sdispls[j] * st->size,
+                    seg_window((char *)sendbuf + (size_t)sdispls[j] * (size_t)st->size,
                                off, c, w, wlen, 1);
                 off += c;
             }
@@ -572,9 +572,9 @@ int MPI_Alltoallv(const void *sendbuf, const int *sendcounts,
         off = 0;
         for (int i = 0; i < NP; i++)
             for (int j = 0; j < NP; j++) {
-                size_t c = H->counts[(size_t)i * NP + j];
+                size_t c = H->counts[(size_t)i * (size_t)NP + (size_t)j];
                 if (j == RANK)
-                    seg_window((char *)recvbuf + (size_t)rdispls[i] * rt->size,
+                    seg_window((char *)recvbuf + (size_t)rdispls[i] * (size_t)rt->size,
                                off, c, w, wlen, 0);
                 off += c;
             }
@@ -614,7 +614,7 @@ int MPI_Allreduce(const void *sendbuf, void *recvbuf, int count,
         0, (size_t)count * (size_t)dt->size, "MPI_Allreduce");
     size_t step = slice_chunk(bytes);
     step -= step % (size_t)dt->size; /* keep rank slices element-aligned */
-    if (bytes && !step) need(bytes * NP, "MPI_Allreduce");
+    if (bytes && !step) need(bytes * (size_t)NP, "MPI_Allreduce");
     for (size_t off = 0; off < bytes || off == 0; ) {
         size_t c = bytes - off < step ? bytes - off : step;
         if (c) memcpy(STG + (size_t)RANK * c, (const char *)sendbuf + off, c);
@@ -658,7 +658,7 @@ int MPI_Exscan(const void *sendbuf, void *recvbuf, int count,
         0, (size_t)count * (size_t)dt->size, "MPI_Exscan");
     size_t step = slice_chunk(bytes);
     step -= step % (size_t)dt->size; /* keep rank slices element-aligned */
-    if (bytes && !step) need(bytes * NP, "MPI_Exscan");
+    if (bytes && !step) need(bytes * (size_t)NP, "MPI_Exscan");
     for (size_t off = 0; off < bytes || off == 0; ) {
         size_t c = bytes - off < step ? bytes - off : step;
         if (c) memcpy(STG + (size_t)RANK * c, (const char *)sendbuf + off, c);

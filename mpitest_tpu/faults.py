@@ -52,9 +52,17 @@ from __future__ import annotations
 
 import itertools
 import math
-import os
 import threading
 from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Callable
+
+from mpitest_tpu.utils import knobs
+
+if TYPE_CHECKING:
+    import jax
+    import numpy as np
+
+    from mpitest_tpu.models.api import DistributedSortResult
 
 SITES = (
     "dispatch_error",
@@ -103,11 +111,11 @@ class FaultRegistry:
 
     spec: str
     seed: int = 0
-    sites: dict = field(default_factory=dict)
-    fired: list = field(default_factory=list)
-    on_fire: object = None  # callable(site, detail) | None
+    sites: dict[str, _Site] = field(default_factory=dict)
+    fired: list[tuple[str, dict[str, object]]] = field(default_factory=list)
+    on_fire: Callable[[str, dict[str, object]], None] | None = None
 
-    def __post_init__(self):
+    def __post_init__(self) -> None:
         self._lock = threading.Lock()
         self._rng_state = (self.seed * 0x2545F4914F6CDD1D + 1) & 0xFFFFFFFFFFFFFFFF
         self._seq = 0
@@ -147,7 +155,7 @@ class FaultRegistry:
             s = self.sites.get(site)
             return s is not None and s.remaining > 0
 
-    def fire(self, site: str, **detail) -> bool:
+    def fire(self, site: str, **detail: object) -> bool:
         """Consume one unit of ``site``'s budget; True iff the fault
         fires now.  Records the firing and notifies ``on_fire``."""
         with self._lock:
@@ -211,40 +219,35 @@ def for_run() -> FaultRegistry | None:
     not cumulative across a process)."""
     if _INSTALLED is not None:
         return _INSTALLED
-    spec = os.environ.get("SORT_FAULTS")
+    spec = knobs.get_raw("SORT_FAULTS")
     if not spec:
         return None
     return FaultRegistry(spec, seed=faults_seed())
 
 
 def faults_seed() -> int:
-    v = os.environ.get("SORT_FAULTS_SEED", "0")
-    try:
-        return int(v)
-    except ValueError:
-        raise ValueError(f"SORT_FAULTS_SEED={v!r}: use an integer") from None
+    """``SORT_FAULTS_SEED`` (default 0): the corruption-stream seed."""
+    return knobs.get("SORT_FAULTS_SEED")
 
 
 def validate_env() -> None:
     """Fail-fast parse of the fault knobs (the CLI's [ERROR] contract)."""
-    spec = os.environ.get("SORT_FAULTS")
-    if spec:
-        FaultRegistry(spec, seed=faults_seed())
+    knobs.validate("SORT_FAULTS", "SORT_FAULTS_SEED")
 
 
 class active:
     """Context manager scoping ``reg`` to the current run (re-entrant:
     a donated-retry re-ingest inside a sort nests cleanly)."""
 
-    def __init__(self, reg: FaultRegistry | None):
+    def __init__(self, reg: FaultRegistry | None) -> None:
         self.reg = reg
 
-    def __enter__(self):
+    def __enter__(self) -> FaultRegistry | None:
         if self.reg is not None:
             _ACTIVE.append(self.reg)
         return self.reg
 
-    def __exit__(self, *exc):
+    def __exit__(self, *exc: object) -> bool:
         if self.reg is not None and _ACTIVE and _ACTIVE[-1] is self.reg:
             _ACTIVE.pop()
         if self.reg is not None and not _ACTIVE:
@@ -288,7 +291,9 @@ def drop_pending() -> int:
     return n
 
 
-def apply_exchange_fault(recv_arrays, recv_cnt):
+def apply_exchange_fault(
+    recv_arrays: tuple[jax.Array, ...], recv_cnt: jax.Array,
+) -> tuple[tuple[jax.Array, ...], jax.Array]:
     """Trace-time hook (called from collectives.ragged_all_to_all, i.e.
     between the exchange and the local sort/merge): apply the pending
     exchange fault, if any, to the first traced exchange of the armed
@@ -310,7 +315,8 @@ def apply_exchange_fault(recv_arrays, recv_cnt):
     return (w0,) + tuple(recv_arrays[1:]), recv_cnt
 
 
-def maybe_poison_chunk(words, chunk_idx: int):
+def maybe_poison_chunk(words: tuple[np.ndarray, ...],
+                       chunk_idx: int) -> tuple[np.ndarray, ...]:
     """Ingest-pipeline hook (worker threads): corrupt CHUNK 0's first
     encoded word AFTER the fingerprint fold — the device receives data
     the fingerprint never saw, so the output verifier must flag it.
@@ -332,14 +338,17 @@ def maybe_poison_chunk(words, chunk_idx: int):
     return (w0,) + tuple(words[1:])
 
 
-def maybe_corrupt_result(reg: FaultRegistry | None, res):
+def maybe_corrupt_result(reg: FaultRegistry | None,
+                         res: "DistributedSortResult",
+) -> "DistributedSortResult":
     """Result hook (host side, before verification): swap endpoints
     (breaks sortedness) or duplicate a key (multiset change only — the
     fingerprint's job).  Returns a corrupted copy of ``res``'s words."""
     if reg is None:
         return res
-    import jax
-    import numpy as np
+    import numpy as np  # noqa: F811 — runtime import (lazy; jax-adjacent)
+
+    from mpitest_tpu.models.ingest import checked_device_put
 
     for site in ("result_swap", "result_dup"):
         if reg.sites.get(site) and reg.sites[site].remaining > 0:
@@ -354,7 +363,7 @@ def maybe_corrupt_result(reg: FaultRegistry | None, res):
                         host[a], host[b] = host[b].copy(), host[a].copy()
                     else:
                         host[1] = host[0]
-                new_words.append(jax.device_put(host, w.sharding))
+                new_words.append(checked_device_put(host, w.sharding))
             res.words = tuple(new_words)
             break
     return res

@@ -30,9 +30,9 @@ recompile.  This replaces the reference's silent bucket overflow
 from __future__ import annotations
 
 import math
-import os
 from dataclasses import dataclass
 from functools import lru_cache
+from typing import Any, Callable, Iterator
 
 import jax
 import jax.numpy as jnp
@@ -59,9 +59,10 @@ from mpitest_tpu.models.ingest import (
     use_stream,
 )
 from mpitest_tpu.ops import bitonic, kernels
-from mpitest_tpu.ops.keys import codec_for
+from mpitest_tpu.ops.keys import KeyCodec, codec_for
 from mpitest_tpu.parallel.mesh import AXIS, key_sharding, make_mesh
 from mpitest_tpu.utils import io as kio
+from mpitest_tpu.utils import knobs
 from mpitest_tpu.utils.trace import Tracer
 
 
@@ -74,12 +75,14 @@ from mpitest_tpu.utils.trace import Tracer
 _warm_jits: set[int] = set()
 
 
-def _traced_call(tracer, label: str, fn, *args, **attrs):
+def _traced_call(tracer: Tracer, label: str, fn: Callable[..., Any],
+                 *args: Any, **attrs: object) -> Any:
     """Call a jit program under a span that separates first-call (compile
     included) from warm-call wall time — the split ISSUE/SURVEY §5 needs
     to attribute 'slow run' to compile vs execute."""
     first = id(fn) not in _warm_jits
     name = "jit_compile_execute" if first else "jit_execute"
+    # sortlint: disable=SL003 -- both branches above are registered schema names
     with tracer.spans.span(name, label=label, **attrs):
         out = fn(*args)
     if first:
@@ -137,7 +140,7 @@ class DistributedSortResult:
             parts.append((np.concatenate(segs) if segs else w[:0])[: self.n_valid])
         return codec.decode(tuple(parts))
 
-    def median_probe_raw(self):
+    def median_probe_raw(self) -> Any:
         """The (n/2)-th sorted element as a native-dtype scalar (exact
         bits — float probes must compare bit patterns, since distinct
         float medians can collide under int truncation)."""
@@ -225,7 +228,7 @@ def _word_diffs(words: tuple[np.ndarray, ...]) -> tuple[int, ...]:
 
 
 @lru_cache(maxsize=8)
-def _compile_word_range(dtype_name: str):
+def _compile_word_range(dtype_name: str) -> Callable[..., Any]:
     """Per-word min/max of the encoded key words (msw first) — feeds the
     pass planner for device-resident input (one tiny reduction + scalar
     sync instead of abandoning pass skipping)."""
@@ -247,7 +250,7 @@ def _compile_word_range(dtype_name: str):
 _f64_encode_broken_platforms: set[str] = set()
 
 
-def _device_platform(x) -> str:
+def _device_platform(x: jax.Array) -> str:
     """Platform string of the device(s) holding ``x`` — the memo key for
     the single-device path, whose encode compiles where ``x`` lives."""
     try:
@@ -270,11 +273,12 @@ def _mesh_platform(mesh: Mesh) -> str:
 _F64_GAP_MARKERS = ("bitcast-convert", "X64 element types")
 
 
-def _f64_gap_applies(dtype, codec) -> bool:
+def _f64_gap_applies(dtype: np.dtype, codec: KeyCodec) -> bool:
     return dtype.kind == "f" and codec.n_words == 2
 
 
-def _is_f64_lowering_gap(e, dtype, codec, platform: str) -> bool:
+def _is_f64_lowering_gap(e: Exception, dtype: np.dtype, codec: KeyCodec,
+                         platform: str) -> bool:
     """True iff ``e`` is the known f64 device-encode lowering gap for a
     2-word float dtype; memoizes the verdict for later calls on the same
     platform.  The markers are fragments of ONE message and must all be
@@ -289,13 +293,14 @@ def _is_f64_lowering_gap(e, dtype, codec, platform: str) -> bool:
     return True
 
 
-def _f64_known_broken(platform: str, dtype, codec) -> bool:
+def _f64_known_broken(platform: str, dtype: np.dtype,
+                      codec: KeyCodec) -> bool:
     """Memoized verdict: ``platform`` already tripped the f64 gap."""
     return (_f64_gap_applies(dtype, codec)
             and platform in _f64_encode_broken_platforms)
 
 
-def _f64_host_input(x, tracer):
+def _f64_host_input(x: jax.Array, tracer: Tracer) -> np.ndarray:
     """Engage the documented f64 host fallback: tracer breadcrumbs plus
     the host copy of the device array."""
     tracer.verbose(
@@ -316,7 +321,7 @@ def _host_hi_dup_sniff(hi: np.ndarray) -> bool:
 
 
 @lru_cache(maxsize=4)
-def _compile_pair_sort(impl: str):
+def _compile_pair_sort(impl: str) -> Callable[..., Any]:
     interpret = impl == "bitonic_interpret"
 
     def f(hi, lo):
@@ -331,7 +336,8 @@ _PAIR_CODES = {0: "constant", 1: "bitonic_1w1", 2: "bitonic_1w0",
 
 
 @lru_cache(maxsize=8)
-def _compile_pair_fused(dtype_name: str, impl: str):
+def _compile_pair_fused(dtype_name: str,
+                        impl: str) -> Callable[..., Any]:
     """ONE-dispatch device program for 2-word device-resident local
     sorts: encode + range/dup planning + a ``lax.cond`` tree selecting
     constant-word 1-word engine / variadic ``lax.sort`` / pair engine
@@ -403,8 +409,10 @@ def _compile_pair_fused(dtype_name: str, impl: str):
     return jax.jit(f)
 
 
-def _local_pair_sort(x, is_device, codec, dtype, mesh, tracer,
-                     words_np=None):
+def _local_pair_sort(x: Any, is_device: bool, codec: KeyCodec,
+                     dtype: np.dtype, mesh: Mesh, tracer: Tracer,
+                     words_np: tuple[np.ndarray, ...] | None = None,
+                     ) -> tuple[jax.Array, ...]:
     """Single-device 64-bit sort orchestration — the MSD-hybrid structure
     (VERDICT r3 #1), adaptive like the skew fallback:
 
@@ -460,7 +468,7 @@ def _local_pair_sort(x, is_device, codec, dtype, mesh, tracer,
             dup = _host_hi_dup_sniff(words_np[0])
         with tracer.phase("device_put"):
             dev = mesh.devices.flat[0]
-            words = tuple(jax.device_put(w, dev) for w in words_np)
+            words = tuple(checked_device_put(w, dev) for w in words_np)
     diffs = (int(rng[0]) ^ int(rng[1]), int(rng[2]) ^ int(rng[3]))
     if diffs == (0, 0):  # all keys identical: already sorted
         tracer.counters["local_engine"] = "constant"
@@ -495,19 +503,13 @@ def _local_pair_sort(x, is_device, codec, dtype, mesh, tracer,
     return (hi_s, lo_s)
 
 
-_LOCAL_ENGINES = ("auto", "bitonic", "lax")
-
-
 def _local_engine() -> str:
     """Local (single-device) sort engine: the Pallas bitonic kernel
     (``ops/bitonic.py``) on real TPU backends for large one-word keys —
     measured 2.0-4.2x ``lax.sort`` at 2^26 on v5e post-relayout (r5) —
     ``lax.sort`` otherwise.  ``SORT_LOCAL_ENGINE={auto,bitonic,lax}``
     overrides."""
-    e = os.environ.get("SORT_LOCAL_ENGINE", "auto")
-    if e not in _LOCAL_ENGINES:
-        raise ValueError(f"SORT_LOCAL_ENGINE={e!r}; use one of {_LOCAL_ENGINES}")
-    return e
+    return knobs.get("SORT_LOCAL_ENGINE")
 
 
 def _use_bitonic(engine: str, n_words: int, n: int) -> bool:
@@ -528,7 +530,8 @@ def _bitonic_impl() -> str:
 
 
 @lru_cache(maxsize=8)
-def _compile_local_device(dtype_name: str, engine: str = "auto"):
+def _compile_local_device(dtype_name: str,
+                          engine: str = "auto") -> Callable[..., Any]:
     """1-device program for device-resident input: fused encode + sort."""
     codec = codec_for(np.dtype(dtype_name))
 
@@ -542,7 +545,8 @@ def _compile_local_device(dtype_name: str, engine: str = "auto"):
 
 
 @lru_cache(maxsize=16)
-def _compile_encode_pad(dtype_name: str, total: int, mesh: Mesh | None):
+def _compile_encode_pad(dtype_name: str, total: int,
+                        mesh: Mesh | None) -> Callable[..., Any]:
     """Device-side encode + pad-to-``total``-with-max.  With a mesh, the
     output is sharded on the key axis; with ``mesh=None`` the program runs
     wherever the input lives (used for non-divisible N, whose *input*
@@ -577,7 +581,8 @@ def _compile_encode_pad(dtype_name: str, total: int, mesh: Mesh | None):
 
 
 @lru_cache(maxsize=8)
-def _compile_local(n_words: int, engine: str = "auto"):
+def _compile_local(n_words: int,
+                   engine: str = "auto") -> Callable[..., Any]:
     """The 1-device specialization: both distributed algorithms degenerate
     to the local kernel when the mesh has a single device (no exchange, no
     splitters, no digit passes) — one fused local sort (the Pallas
@@ -593,9 +598,9 @@ def _compile_local(n_words: int, engine: str = "auto"):
 
 
 @lru_cache(maxsize=64)
-def _compile_radix(mesh: Mesh, n_words: int, n: int, digit_bits: int, cap: int,
-                   passes: int, pack: str, donate: bool = False,
-                   fault_token: str = ""):
+def _compile_radix(mesh: Mesh, n_words: int, n: int, digit_bits: int,
+                   cap: int, passes: int, pack: str, donate: bool = False,
+                   fault_token: str = "") -> Callable[..., Any]:
     # fault_token: unique per armed exchange fault (mpitest_tpu.faults) —
     # a poisoned trace gets its own cache entry and can never be served
     # to a clean dispatch.  "" = the shared clean compile.
@@ -627,9 +632,10 @@ def _compile_radix(mesh: Mesh, n_words: int, n: int, digit_bits: int, cap: int,
 
 
 @lru_cache(maxsize=64)
-def _compile_sample(mesh: Mesh, n_words: int, n: int, cap: int, oversample: int,
-                    pack: str, engine: str = "lax", donate: bool = False,
-                    fault_token: str = ""):
+def _compile_sample(mesh: Mesh, n_words: int, n: int, cap: int,
+                    oversample: int, pack: str, engine: str = "lax",
+                    donate: bool = False,
+                    fault_token: str = "") -> Callable[..., Any]:
     # fault_token: see _compile_radix.
     n_ranks = mesh.devices.size
 
@@ -700,7 +706,8 @@ def _sample_skew_sniff(words_np: tuple[np.ndarray, ...], n_ranks: int) -> bool:
 
 
 @lru_cache(maxsize=32)
-def _compile_skew_sniff(mesh: Mesh, n_words: int, n_valid: int, n_ranks: int):
+def _compile_skew_sniff(mesh: Mesh, n_words: int, n_valid: int,
+                        n_ranks: int) -> Callable[..., Any]:
     """Device-side twin of :func:`_sample_skew_sniff` for device-resident
     input (VERDICT r2 #4): the same evenly-strided sample, quantile picks
     and adjacent-equality verdict, computed on the mesh — one tiny
@@ -746,7 +753,8 @@ def _compile_skew_sniff(mesh: Mesh, n_words: int, n_valid: int, n_ranks: int):
     return jax.jit(f)
 
 
-def _host_pad_words(codec, flat, dtype, total):
+def _host_pad_words(codec: KeyCodec, flat: np.ndarray, dtype: np.dtype,
+                    total: int) -> tuple[int, ...] | None:
     """Pad-word tuple for host input shorter than ``total``: the maximum
     real key (encode is order-preserving, so encoding the host max yields
     the lexicographically-max word tuple), or the all-ones sentinel for
@@ -769,19 +777,23 @@ def _auto_digit_bits(diffs: tuple[int, ...]) -> int:
     return 16 if _passes_from_diffs(diffs, 16) < _passes_from_diffs(diffs, 8) else 8
 
 
-def _shard_input(words_np, mesh, n, pad_words=None):
+def _shard_input(words_np: tuple[np.ndarray, ...], mesh: Mesh, n: int,
+                 pad_words: tuple[int, ...] | None = None,
+                 ) -> tuple[jax.Array, ...]:
     P_ = mesh.devices.size
     sharding = key_sharding(mesh)
     out = []
     for i, w in enumerate(words_np):
         if w.size < P_ * n:
             w = np.concatenate([w, np.full(P_ * n - w.size, pad_words[i], np.uint32)])
-        out.append(jax.device_put(w, sharding))
+        out.append(checked_device_put(w, sharding))
     return tuple(out)
 
 
-def radix_pass_states(x, mesh: Mesh | None = None, digit_bits: int | None = None,
-                      cap_factor: float = 2.0, pack: str | None = None):
+def radix_pass_states(
+    x: Any, mesh: Mesh | None = None, digit_bits: int | None = None,
+    cap_factor: float = 2.0, pack: str | None = None,
+) -> Iterator[tuple[int, np.ndarray, np.ndarray]]:
     """Debug observability: the globally digit-sorted array after each LSD
     pass — the TPU twin of the reference's per-pass intermediate dump
     (``DUMP: LOOP %u RADIX %u = %u``, ``mpi_radix_sort.c:175-178``) and of
@@ -834,7 +846,7 @@ def radix_pass_states(x, mesh: Mesh | None = None, digit_bits: int | None = None
         yield k, n, full
 
 
-def _device_mem_high_water(span, mesh: Mesh | None) -> None:
+def _device_mem_high_water(span: Any, mesh: Mesh | None) -> None:
     """Attach the mesh devices' peak-HBM high-water to ``span`` where the
     backend exposes ``memory_stats()`` (real TPU; CPU returns nothing).
     Best-effort telemetry — never raises."""
@@ -852,7 +864,7 @@ def _device_mem_high_water(span, mesh: Mesh | None) -> None:
 
 
 def ingest_to_mesh(
-    x,
+    x: Any,
     mesh: Mesh | None = None,
     tracer: Tracer | None = None,
     chunk_elems: int | None = None,
@@ -871,7 +883,7 @@ def ingest_to_mesh(
     if mesh is None:
         mesh = make_mesh()
     tracer = tracer or Tracer()
-    trace_path = os.environ.get("SORT_TRACE")
+    trace_path = knobs.get("SORT_TRACE")
     if trace_path and tracer.spans.stream_path is None:
         tracer.spans.stream_path = trace_path
     reg = faults.for_run()
@@ -884,7 +896,7 @@ def ingest_to_mesh(
 
 
 def sort(
-    x,
+    x: Any,
     algorithm: str = "radix",
     mesh: Mesh | None = None,
     digit_bits: int | None = None,
@@ -893,7 +905,7 @@ def sort(
     tracer: Tracer | None = None,
     return_result: bool = False,
     pack: str | None = None,   # exchange pack impl; None = auto by backend
-):
+) -> Any:
     """Sort integer keys on the mesh; returns a sorted numpy array
     (or the device-resident :class:`DistributedSortResult`).
 
@@ -916,7 +928,7 @@ def sort(
     if algorithm not in ("radix", "sample"):
         raise ValueError(f"unknown algorithm {algorithm!r}")
     tracer = tracer or Tracer()
-    trace_path = os.environ.get("SORT_TRACE")
+    trace_path = knobs.get("SORT_TRACE")
     if trace_path and tracer.spans.stream_path is None:
         tracer.spans.stream_path = trace_path
     size = getattr(x, "size", None)
@@ -936,7 +948,7 @@ def sort(
 
 
 def _sort_impl(
-    x,
+    x: Any,
     algorithm: str,
     mesh: Mesh | None,
     digit_bits: int | None,
@@ -946,7 +958,7 @@ def _sort_impl(
     return_result: bool,
     pack: str | None,
     reg: "faults.FaultRegistry | None" = None,
-):
+) -> Any:
     """The sort() body (see the public wrapper's docstring — this layer
     assumes a validated algorithm and a live tracer/span log).
 
@@ -1139,7 +1151,8 @@ def _sort_impl(
                     fp_in = vfy.fingerprint_host(words_np)
             with tracer.phase("device_put"):
                 words = tuple(
-                    jax.device_put(w, mesh.devices.flat[0]) for w in words_np
+                    checked_device_put(w, mesh.devices.flat[0])
+                    for w in words_np
                 )
             with tracer.phase("sort"):
                 out = _traced_call(tracer, "local",
@@ -1179,7 +1192,7 @@ def _sort_impl(
                 # sharded there); a committed single-device array would
                 # otherwise conflict with the jit's mesh-wide
                 # out_shardings.
-                x_flat = jax.device_put(x_flat, key_sharding(mesh))
+                x_flat = checked_device_put(x_flat, key_sharding(mesh))
                 return _traced_call(
                     tracer, "encode_pad",
                     _compile_encode_pad(dtype.name, N, mesh), x_flat)
@@ -1189,7 +1202,8 @@ def _sort_impl(
                 tracer, "encode_pad",
                 _compile_encode_pad(dtype.name, n_ranks * n, None),
                 x_flat)
-            return tuple(jax.device_put(w, key_sharding(mesh)) for w in ws)
+            return tuple(checked_device_put(w, key_sharding(mesh))
+                         for w in ws)
 
         try:
             with tracer.phase("encode"):

@@ -56,6 +56,7 @@ import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
+from typing import TYPE_CHECKING
 
 import jax
 import numpy as np
@@ -66,7 +67,13 @@ from mpitest_tpu.models.verify import Fingerprint, fingerprint_host
 from mpitest_tpu.ops.keys import codec_for
 from mpitest_tpu.parallel.mesh import assemble_sharded, shard_bounds
 from mpitest_tpu.utils import io as kio
-from mpitest_tpu.utils.spans import merge_intervals, overlap_seconds
+from mpitest_tpu.utils.spans import (SpanLog, merge_intervals,
+                                     overlap_seconds)
+
+if TYPE_CHECKING:
+    from jax.sharding import Mesh
+
+    from mpitest_tpu.utils.trace import Tracer
 
 #: ``SORT_INGEST=auto`` streams only above this many key *bytes* — below
 #: it the monolithic path's single device_put beats the pipeline's
@@ -78,7 +85,9 @@ STREAM_MIN_BYTES = 1 << 25
 EGRESS_MIN_BYTES = 1 << 22
 
 
-def checked_device_put(x, target):
+def checked_device_put(x: "np.ndarray | jax.Array",
+                       target: "jax.sharding.Sharding | jax.Device",
+                       ) -> jax.Array:
     """``jax.device_put`` with a dtype-preservation guard: raises on ANY
     host→device dtype change instead of JAX's silent downcast.  Without
     x64, ``device_put`` of an int64/uint64/float64 host array silently
@@ -187,7 +196,7 @@ class StagedIngest:
 class _StreamState:
     """Cross-thread accumulator for stats and planner inputs."""
 
-    def __init__(self, n_words: int, fold_fp: bool = True):
+    def __init__(self, n_words: int, fold_fp: bool = True) -> None:
         self.lock = threading.Lock()
         self.word_min = [None] * n_words
         self.word_max = [None] * n_words
@@ -201,7 +210,9 @@ class _StreamState:
         self.fold_fp = fold_fp
         self.fp = Fingerprint.empty(n_words) if fold_fp else None
 
-    def fold_chunk(self, chunk, words, t0: float, dt_s: float) -> None:
+    def fold_chunk(self, chunk: np.ndarray,
+                   words: tuple[np.ndarray, ...],
+                   t0: float, dt_s: float) -> None:
         # full-chunk scans OUTSIDE the lock (they are the expensive
         # part; holding the lock across them would serialize the encode
         # pool) — only the scalar folds need mutual exclusion
@@ -233,11 +244,13 @@ class _StreamState:
         )
 
 
-def _spans_of(tracer):
+def _spans_of(tracer: "Tracer | None") -> "SpanLog | None":
     return tracer.spans if tracer is not None else None
 
 
-def stream_to_mesh(x, mesh, tracer=None, chunk_elems: int | None = None,
+def stream_to_mesh(x: np.ndarray, mesh: "Mesh",
+                   tracer: "Tracer | None" = None,
+                   chunk_elems: int | None = None,
                    threads: int | None = None) -> StagedIngest:
     """Run the full parse→encode→DMA pipeline over host keys ``x`` (a
     numpy array — possibly mmap-backed, in which case chunks page in
@@ -463,8 +476,9 @@ def stream_to_mesh(x, mesh, tracer=None, chunk_elems: int | None = None,
     )
 
 
-def stream_result_to_numpy(words, n_valid: int, dtype,
-                           tracer=None) -> np.ndarray:
+def stream_result_to_numpy(words: tuple[jax.Array, ...], n_valid: int,
+                           dtype: "np.dtype | str",
+                           tracer: "Tracer | None" = None) -> np.ndarray:
     """Streamed egress for contiguous (non-ragged) sorted results: fetch
     shard k+1 device→host on a dedicated thread while shard k decodes —
     the mirror image of the ingest pipeline, with ``egress.*`` spans.

@@ -49,6 +49,8 @@ so output is bit-identical run to run — arrival order never matters.
 
 from __future__ import annotations
 
+from typing import TYPE_CHECKING
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -58,11 +60,15 @@ from mpitest_tpu.parallel import collectives as coll
 from mpitest_tpu.parallel.mesh import AXIS
 from mpitest_tpu.utils import spans
 
+if TYPE_CHECKING:
+    import contextlib
+
 Words = tuple[jax.Array, ...]
 
 
-def _pass_span(k: int, w_idx: int, shift: int, digit_bits: int, n: int,
-               cap: int):
+def _pass_span(
+    k: int, w_idx: int, shift: int, digit_bits: int, n: int, cap: int,
+) -> "contextlib.AbstractContextManager[spans.Span | None]":
     """Trace-time span for one radix pass (utils/spans.py granularity
     contract): the collectives traced inside the pass body nest under
     it, so the SORT_TRACE stream shows pass → {all_gather, exchange}
@@ -119,7 +125,8 @@ def _lane_slots(recv_cnt: jax.Array, H: jax.Array, digit_base: jax.Array,
     return jnp.where(valid, slot, n).astype(jnp.int32)
 
 
-def _send_segments(sorted_dest: jax.Array, n: int, n_ranks: int):
+def _send_segments(sorted_dest: jax.Array, n: int,
+                   n_ranks: int) -> tuple[jax.Array, jax.Array]:
     """Contiguous per-destination-device segments of the dest-monotone
     shard (dest strictly increasing ⇒ one segment per device)."""
     bounds = lax.iota(jnp.int32, n_ranks) * n

@@ -39,10 +39,14 @@ observable, not just present.
 
 from __future__ import annotations
 
-import os
 import time
+from typing import TYPE_CHECKING, Callable
 
 from mpitest_tpu import faults as flt
+from mpitest_tpu.utils import knobs
+
+if TYPE_CHECKING:
+    from mpitest_tpu.utils.trace import Tracer
 
 
 class SortFaultError(RuntimeError):
@@ -64,49 +68,34 @@ class ExchangeCapExceeded(Exception):
     """Internal control flow of :meth:`SortSupervisor.exchange_loop`:
     the exchange needs a cap beyond the caller's bound."""
 
-    def __init__(self, need: int, limit: int):
+    def __init__(self, need: int, limit: int) -> None:
         super().__init__(f"exchange needs cap {need} > bound {limit}")
         self.need = need
         self.limit = limit
 
 
 def max_retries() -> int:
-    v = os.environ.get("SORT_MAX_RETRIES", "2")
-    try:
-        n = int(v)
-    except ValueError:
-        n = -1
-    if n < 0:
-        raise ValueError(f"SORT_MAX_RETRIES={v!r}: use an integer >= 0")
-    return n
+    """``SORT_MAX_RETRIES`` (default 2): the dispatch retry budget."""
+    return knobs.get("SORT_MAX_RETRIES")
 
 
 def retry_backoff() -> float:
-    v = os.environ.get("SORT_RETRY_BACKOFF", "0.05")
-    try:
-        b = float(v)
-    except ValueError:
-        b = -1.0
-    if not b >= 0.0:
-        raise ValueError(f"SORT_RETRY_BACKOFF={v!r}: use a number >= 0")
-    return b
+    """``SORT_RETRY_BACKOFF`` (default 0.05): base backoff seconds."""
+    return knobs.get("SORT_RETRY_BACKOFF")
 
 
 def fallback_enabled() -> bool:
-    v = os.environ.get("SORT_FALLBACK", "1")
-    if v not in ("0", "1"):
-        raise ValueError(f"SORT_FALLBACK={v!r}: use '1' or '0'")
-    return v == "1"
+    """``SORT_FALLBACK`` (default on): the degradation ladder switch."""
+    return knobs.get("SORT_FALLBACK")
 
 
 def verify_enabled() -> bool:
-    v = os.environ.get("SORT_VERIFY", "1")
-    if v not in ("0", "1"):
-        raise ValueError(f"SORT_VERIFY={v!r}: use '1' or '0'")
-    return v == "1"
+    """``SORT_VERIFY`` (default on): the always-on output verifier."""
+    return knobs.get("SORT_VERIFY")
 
 
-def wire_registry(reg, tracer) -> None:
+def wire_registry(reg: flt.FaultRegistry | None,
+                  tracer: "Tracer") -> None:
     """Point a fault registry's ``on_fire`` at a tracer: every injected
     fault becomes a ``fault`` span event + a ``faults_injected`` count.
     Wired as early as possible in a run — the ingest-poison site fires
@@ -128,7 +117,8 @@ class SortSupervisor:
     """Per-run supervisor: owns the retry budget, the fault registry
     hookup, and the shared cap-regrow loop.  One instance per sort()."""
 
-    def __init__(self, tracer, registry: "flt.FaultRegistry | None" = None):
+    def __init__(self, tracer: "Tracer",
+                 registry: "flt.FaultRegistry | None" = None) -> None:
         self.tracer = tracer
         self.registry = registry
         self.max_retries = max_retries()
@@ -163,7 +153,10 @@ class SortSupervisor:
                 "INTERNAL: injected fault (SORT_FAULTS=dispatch_error)")
 
     # -- dispatch with bounded retry + backoff ------------------------
-    def dispatch(self, label: str, fn, args_fn, on_retry=None, **attrs):
+    def dispatch(self, label: str, fn: Callable[..., object],
+                 args_fn: Callable[[], tuple[object, ...]],
+                 on_retry: Callable[[], None] | None = None,
+                 **attrs: object) -> object:
         """Run ``fn(*args_fn())`` under the retry budget.  ``args_fn`` is
         re-evaluated per attempt (donated buffers must be re-staged
         after a failed attempt — ``on_retry`` marks them dead so the
@@ -207,9 +200,13 @@ class SortSupervisor:
                 attempt += 1
 
     # -- the ONE cap-regrow loop --------------------------------------
-    def exchange_loop(self, label: str, attempt, cap: int, align: int,
-                      round_cap, cap_limit: int | None = None,
-                      on_overflow=None):
+    def exchange_loop(self, label: str,
+                      attempt: "Callable[[int], tuple[object, int]]",
+                      cap: int, align: int,
+                      round_cap: Callable[[int, int], int],
+                      cap_limit: int | None = None,
+                      on_overflow: Callable[[], None] | None = None,
+                      ) -> tuple[object, int]:
         """Run ``attempt(cap) -> (payload, max_cnt)`` until the exchange
         fits; grow the cap to the reported need otherwise.  The cap only
         ever grows (bounded by the shard size), so the loop terminates.

@@ -39,8 +39,14 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 from functools import lru_cache
+from typing import TYPE_CHECKING, Callable
 
 import numpy as np
+
+if TYPE_CHECKING:
+    import jax
+
+    from mpitest_tpu.models.api import DistributedSortResult
 
 _U32 = 0xFFFFFFFF
 
@@ -65,7 +71,7 @@ class Fingerprint:
         return Fingerprint(0, (0,) * n_words, (0,) * n_words)
 
 
-def fingerprint_host(words) -> Fingerprint:
+def fingerprint_host(words: "tuple[np.ndarray, ...]") -> Fingerprint:
     """Fold host uint32 word arrays (one numpy pass, memory-bound)."""
     words = tuple(np.asarray(w, dtype=np.uint32) for w in words)
     return Fingerprint(
@@ -77,7 +83,7 @@ def fingerprint_host(words) -> Fingerprint:
 
 # ------------------------------------------------------------------ device
 
-def _xor_reduce_1d(w):
+def _xor_reduce_1d(w: "jax.Array") -> "jax.Array":
     """XOR-reduce a 1-D uint32 array with a trace-time halving fold —
     XLA's SPMD partitioner only understands the standard reduction
     kinds (a custom xor ``lax.reduce`` is UNIMPLEMENTED on sharded
@@ -99,7 +105,7 @@ def _xor_reduce_1d(w):
 
 @lru_cache(maxsize=64)
 def _compile_contig(n_words: int, n_valid: int, total: int,
-                    check_sorted: bool):
+                    check_sorted: bool) -> "Callable[..., object]":
     """Fingerprint (+ optional sortedness) of a contiguous layout: real
     keys occupy [0, n_valid), pads (max key / sentinel) the tail.  The
     valid-region reduction is pad-region subtraction — two static
@@ -134,7 +140,8 @@ def _compile_contig(n_words: int, n_valid: int, total: int,
 
 
 @lru_cache(maxsize=64)
-def _compile_ragged(n_words: int, n_valid: int, slots: int, n_ranks: int):
+def _compile_ragged(n_words: int, n_valid: int, slots: int,
+                    n_ranks: int) -> "Callable[..., object]":
     """Fingerprint + sortedness of the ragged (sample) layout: shard r
     owns slots [r·S, (r+1)·S), of which the first counts[r] are valid,
     sentinel fill sorted to the shard tail.  Valid lanes below global
@@ -211,7 +218,7 @@ def _compile_ragged(n_words: int, n_valid: int, slots: int, n_ranks: int):
 
 
 @lru_cache(maxsize=16)
-def _compile_encode_fp(dtype_name: str):
+def _compile_encode_fp(dtype_name: str) -> "Callable[..., object]":
     """Fused device-side encode + fingerprint for raw (unencoded)
     device-resident input — the single-device local paths, whose sort
     programs fuse their own encode and never expose the words."""
@@ -231,7 +238,8 @@ def _compile_encode_fp(dtype_name: str):
     return jax.jit(f)
 
 
-def fingerprint_device_input(x, dtype) -> Fingerprint:
+def fingerprint_device_input(x: "jax.Array",
+                             dtype: "np.dtype | str") -> Fingerprint:
     """Fingerprint of raw device-resident keys (encode fused in)."""
     xors, sums = _compile_encode_fp(np.dtype(dtype).name)(x)
     return Fingerprint(int(x.size),
@@ -239,7 +247,8 @@ def fingerprint_device_input(x, dtype) -> Fingerprint:
                        tuple(int(s) for s in sums))
 
 
-def fingerprint_device(words, n_valid: int) -> Fingerprint:
+def fingerprint_device(words: "tuple[jax.Array, ...]",
+                       n_valid: int) -> Fingerprint:
     """Input-side device fingerprint over a contiguous padded layout
     (one tiny fused reduction, one scalar sync)."""
     n_words = len(words)
@@ -250,7 +259,8 @@ def fingerprint_device(words, n_valid: int) -> Fingerprint:
                        tuple(int(s) for s in sums))
 
 
-def verify_result(res, input_fp: Fingerprint | None) -> tuple[bool, bool]:
+def verify_result(res: "DistributedSortResult",
+                  input_fp: Fingerprint | None) -> tuple[bool, bool]:
     """Verify a DistributedSortResult on device: returns
     ``(sorted_ok, fp_ok)``.  ``fp_ok`` is True when no input fingerprint
     is available (nothing to compare — sortedness still gates)."""

@@ -18,7 +18,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 AXIS = "x"  # the single key axis; all sharding is 1-D over it
 
 
-def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+def make_mesh(n_devices: int | None = None,
+              devices: "list[jax.Device] | None" = None) -> Mesh:
     """Build the 1-D mesh over all (or the first ``n_devices``) devices."""
     if devices is None:
         devices = jax.devices()
@@ -54,7 +55,8 @@ def shard_bounds(mesh: Mesh, n_per_shard: int) -> list[tuple]:
     ]
 
 
-def assemble_sharded(mesh: Mesh, per_device: list, total: int):
+def assemble_sharded(mesh: Mesh, per_device: "list[jax.Array]",
+                     total: int) -> jax.Array:
     """Glue per-device single-device buffers (one per mesh device, in
     mesh order, each already committed to its device) into ONE
     key-axis-sharded global array — zero host copies, the closing step

@@ -40,6 +40,10 @@ import json
 import sys
 from pathlib import Path
 
+from mpitest_tpu.utils import span_schema
+from mpitest_tpu.utils.span_schema import (FAULT_SPAN, INGEST_HOST_STAGES,
+                                           INGEST_XFER_STAGES, PHASE_PREFIX,
+                                           RETRY_SPAN, VERIFY_SPAN)
 from mpitest_tpu.utils.spans import (MPI_EQUIV, SCHEMA as SPAN_SCHEMA,
                                      merge_intervals, overlap_seconds)
 
@@ -86,15 +90,15 @@ def load_rows(path: str) -> list[dict]:
 
 # ----------------------------------------------------------- aggregation
 
-#: Ingest/egress pipeline stages and which side of the host/device
-#: boundary each works.  Overlap is computed PER DIRECTION (the span
-#: name's prefix): ingest host work against ingest transfers, egress
-#: decode against egress fetches — pooling them would let egress-only
-#: overlap satisfy the --require-ingest-overlap gate after an ingest
-#: regression.  ``ingest.pipeline`` is the umbrella span and is
-#: excluded from per-stage sums (it would double-count its children).
-INGEST_HOST_STAGES = ("ingest.parse", "ingest.encode", "egress.decode")
-INGEST_XFER_STAGES = ("ingest.transfer", "egress.fetch")
+# Ingest/egress stage split: imported from utils/span_schema.py — the
+# ONE registered vocabulary producers and this consumer share, enforced
+# by sortlint rule SL003 (a renamed span can no longer silently vanish
+# from these tables).  Overlap is computed PER DIRECTION (the span
+# name's prefix): ingest host work against ingest transfers, egress
+# decode against egress fetches — pooling them would let egress-only
+# overlap satisfy the --require-ingest-overlap gate after an ingest
+# regression.  ``ingest.pipeline`` is the umbrella span and is
+# excluded from per-stage sums (it would double-count its children).
 
 
 def aggregate(rows: list[dict]) -> dict:
@@ -122,6 +126,10 @@ def aggregate(rows: list[dict]) -> dict:
     # into one table so a chaos run's telemetry is one `report` away.
     robust = {"faults": 0, "fault_sites": {}, "retries": 0,
               "verify_runs": 0, "verify_failures": 0}
+    # tooling state (ISSUE 4): bench rows stamp the lint/sanitizer gate
+    # versions; the report surfaces the last-seen state so a table of
+    # numbers names the rule set that guarded them.
+    tooling: dict | None = None
     # overlap intervals grouped per (file, pid): t0 is a process-relative
     # perf_counter clock, so intervals from different runs appended to
     # one SORT_TRACE file live on unrelated timelines — comparing them
@@ -142,8 +150,8 @@ def aggregate(rows: list[dict]) -> dict:
         if kind == "span":
             name = obj.get("name", "?")
             span_counts[name] = span_counts.get(name, 0) + 1
-            if name.startswith("phase:"):
-                p = phases.setdefault(name[len("phase:"):],
+            if name.startswith(PHASE_PREFIX):
+                p = phases.setdefault(name[len(PHASE_PREFIX):],
                                       {"ms": 0.0, "count": 0})
                 p["ms"] += float(obj.get("dt", 0.0)) * 1e3
                 p["count"] += 1
@@ -151,14 +159,14 @@ def aggregate(rows: list[dict]) -> dict:
                 add_coll("tpu", MPI_EQUIV[name], 1,
                          obj.get("attrs", {}).get("bytes", 0),
                          obj.get("dt", 0.0))
-            elif name == "fault":
+            elif name == FAULT_SPAN:
                 robust["faults"] += 1
                 site = obj.get("attrs", {}).get("site", "?")
                 robust["fault_sites"][site] = \
                     robust["fault_sites"].get(site, 0) + 1
-            elif name == "supervisor_retry":
+            elif name == RETRY_SPAN:
                 robust["retries"] += 1
-            elif name == "verify":
+            elif name == VERIFY_SPAN:
                 robust["verify_runs"] += 1
                 if not obj.get("attrs", {}).get("ok", True):
                     robust["verify_failures"] += 1
@@ -193,6 +201,8 @@ def aggregate(rows: list[dict]) -> dict:
         elif kind == "bench":
             metrics[obj["metric"]] = {k: v for k, v in obj.items()
                                       if not k.startswith("_")}
+            if isinstance(obj.get("tooling"), dict):
+                tooling = obj["tooling"]
     def direction_overlap(direction: str) -> dict | None:
         runs = {r for r in set(host_iv) | set(xfer_iv) if r[2] == direction}
         if not runs:
@@ -209,6 +219,7 @@ def aggregate(rows: list[dict]) -> dict:
 
     return {"phases": phases, "collectives": colls, "metrics": metrics,
             "spans": span_counts, "ingest": ingest, "robustness": robust,
+            "tooling": tooling,
             "ingest_overlap": direction_overlap("ingest"),
             "egress_overlap": direction_overlap("egress")}
 
@@ -368,6 +379,11 @@ def render(agg: dict) -> str:
         out.append("")
         out.append("span census: " + ", ".join(
             f"{n}={c}" for n, c in sorted(agg["spans"].items())))
+    if agg.get("tooling"):
+        out.append("")
+        out.append("tooling state (lint/sanitizer gates of the bench rows): "
+                   + ", ".join(f"{k}={v}" for k, v in
+                               sorted(agg["tooling"].items())))
     return "\n".join(out) if out else "(no telemetry rows)"
 
 
@@ -384,6 +400,12 @@ def main(argv: list[str] | None = None) -> int:
                          " when present)")
     ap.add_argument("--check", action="store_true",
                     help="schema-validate the files; exit 1 on violations")
+    ap.add_argument("--require-registered-spans", action="store_true",
+                    help="with --check: also fail on span names outside "
+                         "the registered schema (utils/span_schema.py) — "
+                         "the telemetry-selftest gate that makes a "
+                         "renamed span a loud failure instead of a "
+                         "silently thinner report")
     ap.add_argument("--require-ingest-overlap", action="store_true",
                     help="exit 1 unless the ingest.* spans show nonzero "
                          "parse/encode ∩ transfer overlap (the `make "
@@ -414,14 +436,25 @@ def main(argv: list[str] | None = None) -> int:
             print(f"[ERROR] {f}: {e}", file=sys.stderr)
             return 1
 
-    if args.check:
-        errors = check_rows(rows)
+    # each gate runs standalone — --require-registered-spans without
+    # --check must still check (a gate that silently skips is worse
+    # than no gate)
+    errors = check_rows(rows) if args.check else []
+    if args.require_registered_spans:
+        for r in rows:
+            if (r.get("kind") == "span"
+                    and not span_schema.is_registered(r.get("name", "?"))):
+                errors.append(
+                    f"{r.get('_path')}:{r.get('_line')}: span name "
+                    f"{r.get('name')!r} is not in the registered "
+                    "schema (utils/span_schema.py)")
+    if errors:
+        for e in errors:
+            print(f"[ERROR] {e}", file=sys.stderr)
+        return 1
+    if args.check or args.require_registered_spans:
         n_spans = sum(1 for r in rows if r.get("kind") == "span")
         n_stats = sum(1 for r in rows if r.get("kind") == "comm_stats")
-        if errors:
-            for e in errors:
-                print(f"[ERROR] {e}", file=sys.stderr)
-            return 1
         print(f"telemetry check OK: {len(rows)} rows "
               f"({n_spans} spans, {n_stats} comm_stats) across "
               f"{len(files)} file(s)")

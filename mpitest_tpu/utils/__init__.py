@@ -1,1 +1,2 @@
-from mpitest_tpu.utils import io, metrics, spans, trace  # noqa: F401
+from mpitest_tpu.utils import (  # noqa: F401
+    io, knobs, metrics, span_schema, spans, trace)

@@ -22,9 +22,9 @@ canonical reader for both the CLI and the library.
 
 from __future__ import annotations
 
-import os
-
 import numpy as np
+
+from mpitest_tpu.utils import knobs
 
 
 #: Binary key-file header (mirrored in native/sort_common.h): 8 bytes of
@@ -134,8 +134,9 @@ def write_keys_binary(path: str, keys: np.ndarray) -> None:
 #: Default elements per streamed chunk: 2^22 keys = 16 MiB of int32 —
 #: large enough to amortize per-chunk dispatch, small enough that the
 #: double-buffered pipeline holds only tens of MiB of host memory and
-#: a 2^28 bench run pipelines across 64 chunks.
-DEFAULT_CHUNK_ELEMS = 1 << 22
+#: a 2^28 bench run pipelines across 64 chunks.  (Registered — with the
+#: rest of the ingest knobs — in utils/knobs.py.)
+DEFAULT_CHUNK_ELEMS = knobs.DEFAULT_INGEST_CHUNK
 
 INGEST_MODES = ("auto", "stream", "mono")
 
@@ -146,41 +147,21 @@ def ingest_mode() -> str:
     overlap to pay for the pipeline's thread machinery; ``stream``
     forces the pipeline at any size (tests, the selftest); ``mono``
     forces the legacy monolithic encode + one device_put."""
-    m = os.environ.get("SORT_INGEST", "auto")
-    if m not in INGEST_MODES:
-        raise ValueError(f"SORT_INGEST={m!r}; use one of {INGEST_MODES}")
-    return m
+    return knobs.get("SORT_INGEST")
 
 
 def ingest_chunk_elems() -> int:
     """Elements per streamed chunk (``SORT_INGEST_CHUNK``, default
     :data:`DEFAULT_CHUNK_ELEMS`)."""
-    v = os.environ.get("SORT_INGEST_CHUNK")
-    if v is None:
-        return DEFAULT_CHUNK_ELEMS
-    try:
-        c = int(v)
-    except ValueError:
-        c = 0
-    if c < 1:
-        raise ValueError(f"SORT_INGEST_CHUNK={v!r}: use an integer >= 1")
-    return c
+    v = knobs.get("SORT_INGEST_CHUNK")
+    return DEFAULT_CHUNK_ELEMS if v is None else v
 
 
 def ingest_threads() -> int:
     """Host parse/encode worker threads (``SORT_INGEST_THREADS``,
     default 2 — one chunk encoding while another parses; the DMA issue
     thread is separate and always single so transfers stay in order)."""
-    v = os.environ.get("SORT_INGEST_THREADS")
-    if v is None:
-        return 2
-    try:
-        t = int(v)
-    except ValueError:
-        t = 0
-    if t < 1:
-        raise ValueError(f"SORT_INGEST_THREADS={v!r}: use an integer >= 1")
-    return t
+    return knobs.get("SORT_INGEST_THREADS")
 
 
 DONATE_MODES = ("auto", "1", "0")
@@ -191,10 +172,7 @@ def donate_setting() -> str:
     of the accepted set, shared by the CLI's fail-fast block and the
     sort dispatch's resolver (models/api.py), which maps ``auto`` to
     backend-dependent behavior."""
-    v = os.environ.get("SORT_DONATE", "auto")
-    if v not in DONATE_MODES:
-        raise ValueError(f"SORT_DONATE={v!r}: use 'auto', '1' or '0'")
-    return v
+    return knobs.get("SORT_DONATE")
 
 
 def sniff_format(path: str) -> str:

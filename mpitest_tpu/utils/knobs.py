@@ -1,0 +1,511 @@
+"""Central env-knob registry — the ONE place the process environment is read.
+
+Before this module, ~36 ``os.environ`` reads were scattered across
+``models/``, ``parallel/``, ``utils/``, ``faults.py``, the CLI driver and
+the bench scripts, each with its own ad-hoc validation (or none).  A
+typo'd knob could die deep inside a sort, and nothing listed what knobs
+even existed.  Now every knob is **registered** here with a name, type,
+default, validator and one-line doc; every read goes through
+:func:`get` / :func:`get_raw`; and the whole surface is self-documenting
+(:func:`reference_table` emits the markdown table README embeds —
+``python -m mpitest_tpu.utils.knobs`` prints it).
+
+The contract is enforced mechanically: ``tools/sortlint`` rule
+``SL001 env-knob-read`` fails the lint gate on any ``os.environ.get`` /
+``os.getenv`` / ``os.environ[...]`` read outside this file.  Writes
+(``os.environ[k] = v``, ``setdefault``, building a subprocess env with
+``dict(os.environ, ...)``) stay legal everywhere — the rule targets
+*reads*, because reads are where unvalidated garbage enters.
+
+Validation is fail-fast and message-stable: a bad value raises
+:class:`KnobError` (a ``ValueError``) whose text names the knob and the
+accepted values — the same ``[ERROR]``-line contract the CLI has had
+since round 1, now produced in exactly one place.
+
+Native-consumed knobs (``COMM_RANKS``, ``COMM_FAULTS``, ...) are
+registered too with ``consumer="native"`` so the reference table covers
+the whole system; their values are parsed and validated by the C side
+(``comm/comm_faults.h`` etc.), so :func:`get` returns them raw.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import math
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, Iterator
+
+__all__ = [
+    "Knob", "KnobError", "get", "get_raw", "iter_knobs", "main",
+    "reference_table", "register", "scoped_env", "validate",
+]
+
+#: Default elements per streamed ingest chunk: 2^22 keys = 16 MiB of
+#: int32 (utils/io.py re-exports this as DEFAULT_CHUNK_ELEMS).
+DEFAULT_INGEST_CHUNK = 1 << 22
+
+
+class KnobError(ValueError):
+    """A knob's value failed validation.  Subclasses ``ValueError`` so
+    every pre-existing ``except ValueError`` fail-fast site still
+    catches it; the message always starts with ``NAME=<raw!r>``."""
+
+
+@dataclass(frozen=True)
+class Knob:
+    """One registered environment knob."""
+
+    name: str
+    kind: str                     # int | float | flag | enum | csv | str | path | spec | dtype
+    default: Any                  # typed default returned when unset (may be None)
+    spec: str                     # one-line "validates" column for the table
+    doc: str                      # one-line description (required non-empty)
+    parse: Callable[[str], Any]   # raw string -> typed value; raises KnobError
+    consumer: str = "python"      # "python" | "native" (validated by the C side)
+
+    def read(self) -> Any:
+        """Typed, validated value of this knob (the default when unset)."""
+        raw = os.environ.get(self.name)
+        if raw is None:
+            if isinstance(self.default, str):
+                # string defaults go through the same parser as env
+                # input, so callers always see the parsed type (e.g.
+                # SORT_DTYPE yields np.dtype whether set or defaulted)
+                return self.parse(self.default)
+            return self.default
+        return self.parse(raw)
+
+
+_REGISTRY: dict[str, Knob] = {}
+
+
+def register(name: str, kind: str, default: Any, spec: str, doc: str,
+             parse: Callable[[str], Any], consumer: str = "python") -> None:
+    """Register one knob.  Every knob must carry a nonempty one-line doc
+    — sortlint rule SL030 fails the gate otherwise, and SL031 fails it
+    when a registered knob is missing from README's reference table."""
+    if not doc:
+        raise ValueError(f"knob {name}: doc must be nonempty")
+    if name in _REGISTRY:
+        raise ValueError(f"knob {name} registered twice")
+    _REGISTRY[name] = Knob(name, kind, default, spec, doc, parse, consumer)
+
+
+def get(name: str) -> Any:
+    """The typed, validated value of registered knob ``name`` (its
+    default when unset).  Raises :class:`KeyError` for unregistered
+    names — reading an unregistered env var is exactly the bug class
+    this module exists to end."""
+    return _REGISTRY[name].read()
+
+
+def get_raw(name: str) -> str | None:
+    """The raw (unparsed) string value of a *registered* knob, or None
+    when unset — for pass-through uses (subprocess env plumbing,
+    read-modify-write of ``XLA_FLAGS``) where the consumer parses."""
+    knob = _REGISTRY[name]  # KeyError on unregistered names, like get()
+    return os.environ.get(knob.name)
+
+
+def validate(*names: str) -> None:
+    """Fail-fast parse of the named knobs (all registered python-side
+    knobs when none given) — the CLI's startup contract: garbage in any
+    knob is one clean ``[ERROR]`` line, never a mid-sort stack trace."""
+    for name in names or tuple(_REGISTRY):
+        knob = _REGISTRY[name]
+        if knob.consumer == "python":
+            knob.read()
+
+
+def iter_knobs() -> Iterator[Knob]:
+    """Registered knobs in name order (the table's row order)."""
+    for name in sorted(_REGISTRY):
+        yield _REGISTRY[name]
+
+
+@contextlib.contextmanager
+def scoped_env(**overrides: str | None) -> Iterator[None]:
+    """Temporarily set (or, with ``None``, unset) environment variables,
+    restoring the previous state on exit — the sanctioned way for
+    drivers/tests to flip knobs for a scoped region (the save/restore
+    dance ``bench/fault_selftest.py`` and ``bench/mesh_battery.py`` each
+    hand-rolled before this existed)."""
+    old = {k: os.environ.get(k) for k in overrides}
+    try:
+        for k, v in overrides.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        yield
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+# ------------------------------------------------------------- parse kit
+
+def _int(name: str, lo: int | None = None, hi: int | None = None,
+         err: str | None = None) -> Callable[[str], int]:
+    def parse(raw: str) -> int:
+        v: int | None
+        try:
+            v = int(raw)
+        except ValueError:
+            v = None
+        if (v is None or (lo is not None and v < lo)
+                or (hi is not None and v > hi)):
+            if err is not None:
+                raise KnobError(err.format(name=name, raw=raw)) from None
+            bound = f" >= {lo}" if lo is not None else ""
+            raise KnobError(f"{name}={raw!r}: use an integer{bound}") from None
+        return v
+    return parse
+
+
+def _float_ge0(name: str) -> Callable[[str], float]:
+    def parse(raw: str) -> float:
+        try:
+            v = float(raw)
+        except ValueError:
+            v = -1.0
+        if not v >= 0.0:
+            raise KnobError(f"{name}={raw!r}: use a number >= 0")
+        return v
+    return parse
+
+
+def _flag(name: str) -> Callable[[str], bool]:
+    def parse(raw: str) -> bool:
+        if raw not in ("0", "1"):
+            raise KnobError(f"{name}={raw!r}: use '1' or '0'")
+        return raw == "1"
+    return parse
+
+
+def _enum(name: str, choices: tuple[str, ...],
+          err: str | None = None) -> Callable[[str], str]:
+    def parse(raw: str) -> str:
+        if raw not in choices:
+            raise KnobError(err.format(name=name, raw=raw) if err else
+                            f"{name}={raw!r}; use one of {choices}")
+        return raw
+    return parse
+
+
+def _csv(name: str) -> Callable[[str], tuple[str, ...]]:
+    def parse(raw: str) -> tuple[str, ...]:
+        parts = tuple(p.strip() for p in raw.split(",") if p.strip())
+        if not parts:
+            raise KnobError(f"{name}={raw!r}: use a comma-separated list")
+        return parts
+    return parse
+
+
+def _passthrough(raw: str) -> str:
+    return raw
+
+
+# ---------------------------------------------------------- registrations
+# Core sort knobs (drivers/sort_cli.py + models/api.py).
+
+register("SORT_ALGO", "enum", "sample", "sample | radix",
+         "Sort algorithm the CLI dispatches (reference default: sample).",
+         _enum("SORT_ALGO", ("sample", "radix"),
+               err="{name}={raw!r}: use 'sample' or 'radix'"))
+
+
+def _dtype(name: str) -> Callable[[str], Any]:
+    def parse(raw: str) -> Any:
+        from mpitest_tpu.ops.keys import codec_for
+        try:
+            # np.dtype raises TypeError/ValueError/SyntaxError depending
+            # on the garbage; codec_for rejects valid-but-unsupported
+            # dtypes with the supported list in the message.
+            return codec_for(raw).dtype
+        except Exception as e:
+            raise KnobError(f"{name}={raw!r}: {e}") from None
+    return parse
+
+
+register("SORT_DTYPE", "dtype", "int32", "a codec-supported numpy dtype",
+         "Key dtype for text inputs (int32/uint32/int64/uint64/f32/f64).",
+         _dtype("SORT_DTYPE"))
+
+
+def _parse_digit_bits(raw: str) -> int | None:
+    if raw == "auto":
+        return None
+    try:
+        v = int(raw)
+    except ValueError:
+        v = 0
+    if not 1 <= v <= 16:
+        raise KnobError(f"SORT_DIGIT_BITS={raw!r}: use 'auto' or an "
+                        "integer in [1, 16]") from None
+    return v
+
+
+register("SORT_DIGIT_BITS", "int", None, "'auto' or an integer in [1, 16]",
+         "Radix digit width in bits; auto picks from key width and P.",
+         _parse_digit_bits)
+
+
+def _parse_ranks(raw: str) -> int | None:
+    if raw == "":
+        return None
+    try:
+        v = int(raw)
+    except ValueError:
+        v = 0
+    if v < 1:
+        raise KnobError(f"SORT_RANKS={raw!r}: use a positive integer")
+    return v
+
+
+register("SORT_RANKS", "int", None, "a positive integer (default: all devices)",
+         "Mesh size (device count) the sort runs over.", _parse_ranks)
+
+
+def _parse_cap_factor(raw: str) -> float:
+    try:
+        v = float(raw)
+    except ValueError:
+        v = 0.0
+    # isfinite: 'nan' passes a <= 0 gate (NaN compares False) and 'inf'
+    # overflows the downstream int() — both are garbage, same contract.
+    if not math.isfinite(v) or v <= 0:
+        raise KnobError(f"SORT_CAP_FACTOR={raw!r}: use a finite number > 0")
+    return v
+
+
+register("SORT_CAP_FACTOR", "float", 2.0, "a finite number > 0",
+         "Exchange cap as a multiple of the fair per-peer share.",
+         _parse_cap_factor)
+
+
+def _parse_oversample(raw: str) -> int | None:
+    if raw == "":
+        return None
+    try:
+        v = int(raw)
+    except ValueError:
+        v = 0
+    if v < 1:
+        raise KnobError(f"SORT_OVERSAMPLE={raw!r}: use an integer >= 1")
+    return v
+
+
+register("SORT_OVERSAMPLE", "int", None, "an integer >= 1 (default: 2P-1)",
+         "Samples per shard for sample sort's splitter selection.",
+         _parse_oversample)
+
+register("SORT_LOCAL_ENGINE", "enum", "auto", "auto | bitonic | lax",
+         "Local (single-device) sort engine; auto = bitonic on TPU.",
+         _enum("SORT_LOCAL_ENGINE", ("auto", "bitonic", "lax")))
+
+# Observability sidecar paths (off when unset — the byte-compatible CLI
+# contract is untouched by default).
+register("SORT_TRACE", "path", None, "a writable file path",
+         "Stream the structured span log as JSONL to this path.",
+         _passthrough)
+register("SORT_TRACE_CHROME", "path", None, "a writable file path",
+         "Write the run's Chrome trace-event JSON (Perfetto) here.",
+         _passthrough)
+register("SORT_METRICS", "path", None, "a writable file path",
+         "Append one JSON metrics sidecar line per run to this path.",
+         _passthrough)
+register("SORT_PROFILE", "path", None, "a writable directory path",
+         "Capture a jax.profiler trace of the sort into this logdir.",
+         _passthrough)
+
+# Streaming-ingest knobs (utils/io.py + models/ingest.py).
+
+register("SORT_INGEST", "enum", "auto", "auto | stream | mono",
+         "Ingest pipeline selector; auto streams inputs above ~32 MiB.",
+         _enum("SORT_INGEST", ("auto", "stream", "mono")))
+register("SORT_INGEST_CHUNK", "int", None, "an integer >= 1 (default 2^22)",
+         "Keys per streamed ingest chunk.",
+         _int("SORT_INGEST_CHUNK", lo=1))
+register("SORT_INGEST_THREADS", "int", 2, "an integer >= 1",
+         "Host parse/encode worker threads in the ingest pipeline.",
+         _int("SORT_INGEST_THREADS", lo=1))
+register("SORT_DONATE", "enum", "auto", "auto | 1 | 0",
+         "Donate staged word buffers to the SPMD program (auto: on TPU).",
+         _enum("SORT_DONATE", ("auto", "1", "0"),
+               err="{name}={raw!r}: use 'auto', '1' or '0'"))
+
+# Robustness knobs (models/supervisor.py + faults.py).
+
+register("SORT_VERIFY", "flag", True, "1 | 0",
+         "Always-on output verification (sortedness + fingerprint).",
+         _flag("SORT_VERIFY"))
+register("SORT_MAX_RETRIES", "int", 2, "an integer >= 0",
+         "Dispatch retry budget for transient SPMD launch failures.",
+         _int("SORT_MAX_RETRIES", lo=0))
+register("SORT_RETRY_BACKOFF", "float", 0.05, "a number >= 0",
+         "Base seconds of exponential dispatch-retry backoff.",
+         _float_ge0("SORT_RETRY_BACKOFF"))
+register("SORT_FALLBACK", "flag", True, "1 | 0",
+         "Graceful-degradation ladder (other algorithm, then host sort).",
+         _flag("SORT_FALLBACK"))
+
+
+def _parse_faults(raw: str) -> str | None:
+    if not raw:
+        return None
+    from mpitest_tpu import faults
+    faults.FaultRegistry(raw)  # raises ValueError with the site list
+    return raw
+
+
+register("SORT_FAULTS", "spec", None, "comma list of site[:count|:inf]",
+         "Deterministic fault-injection plan (mpitest_tpu/faults.py).",
+         _parse_faults)
+
+
+def _parse_faults_seed(raw: str) -> int:
+    try:
+        return int(raw)
+    except ValueError:
+        raise KnobError(f"SORT_FAULTS_SEED={raw!r}: use an integer") from None
+
+
+register("SORT_FAULTS_SEED", "int", 0, "an integer",
+         "Seed of the splitmix64 stream fault corruption values draw from.",
+         _parse_faults_seed)
+
+# Bench-driver knobs (bench.py).
+
+
+def _parse_bench_platform(raw: str) -> int | None:
+    name, _, ndev = raw.partition(":")
+    if name != "cpu":
+        raise KnobError(f"BENCH_PLATFORM supports cpu[:N], got {raw!r}")
+    try:
+        n = int(ndev) if ndev else 1
+    except ValueError:
+        n = 0
+    if n < 1:
+        raise KnobError(f"BENCH_PLATFORM supports cpu[:N], got {raw!r}")
+    return n
+
+
+register("BENCH_PLATFORM", "str", None, "cpu[:N]",
+         "Force an N-device virtual CPU mesh for TPU-less bench runs.",
+         _parse_bench_platform)
+register("BENCH_DTYPE", "dtype", "int32", "a codec-supported numpy dtype",
+         "Key dtype the bench driver generates and sorts.",
+         _dtype("BENCH_DTYPE"))
+register("BENCH_LOG2N", "int", None, "an integer >= 1 (default 28 TPU / 20 CPU)",
+         "log2 of the bench key count.", _int("BENCH_LOG2N", lo=1))
+register("BENCH_ALGO", "enum", "radix", "radix | sample",
+         "Algorithm the bench driver measures.",
+         _enum("BENCH_ALGO", ("radix", "sample")))
+register("BENCH_REPEATS", "int", 3, "an integer >= 1",
+         "Timed sort repeats; the row reports the best.",
+         _int("BENCH_REPEATS", lo=1))
+register("BENCH_NATIVE_RANKS", "int", 8, "an integer >= 0 (0 disables)",
+         "Host-CPU ranks for the native denominator run.",
+         _int("BENCH_NATIVE_RANKS", lo=0))
+register("BENCH_NATIVE_REPEATS", "int", 3, "an integer >= 1",
+         "Native denominator runs; the median is the denominator.",
+         _int("BENCH_NATIVE_REPEATS", lo=1))
+
+# Bench-script knobs (bench/*.py probes and batteries).
+
+register("F64_LOG2N", "int", 27, "an integer >= 1",
+         "log2 key count for the f64-at-scale probe.",
+         _int("F64_LOG2N", lo=1))
+register("F64_REPEATS", "int", 2, "an integer >= 1",
+         "Repeats for the f64-at-scale probe.", _int("F64_REPEATS", lo=1))
+register("MESHB_PARTS", "csv", ("dtypes", "zipf", "pack", "engines"),
+         "comma list of battery parts",
+         "Which mesh-battery parts to run.", _csv("MESHB_PARTS"))
+register("MESHB_LOG2N", "int", 21, "an integer >= 1",
+         "log2 key count for the mesh battery.", _int("MESHB_LOG2N", lo=1))
+register("STRESS64_LOG2N", "int", None, "an integer >= 1",
+         "log2 key count override for the 64-bit stress battery.",
+         _int("STRESS64_LOG2N", lo=1))
+register("STRESS64_PATTERNS", "csv", None, "comma list of pattern names",
+         "Restrict the 64-bit stress battery to these patterns.",
+         _csv("STRESS64_PATTERNS"))
+register("SKEW_LOG2N", "int", 27, "an integer >= 1",
+         "log2 key count for the skew-at-scale battery.",
+         _int("SKEW_LOG2N", lo=1))
+register("SKEW_REPEATS", "int", 2, "an integer >= 1",
+         "Repeats for the skew-at-scale battery.", _int("SKEW_REPEATS", lo=1))
+register("SKEW_DISTS", "csv", None, "comma list of distribution names",
+         "Restrict the skew battery to these distributions.",
+         _csv("SKEW_DISTS"))
+register("SKEW_MESH_LOG2N", "int", 24, "an integer >= 1",
+         "log2 key count for the skew battery's mesh sweep.",
+         _int("SKEW_MESH_LOG2N", lo=1))
+register("PROBE_LOG2N", "int", 26, "an integer >= 1",
+         "log2 key count for the relayout probe.", _int("PROBE_LOG2N", lo=1))
+register("PROBE_PARTS", "csv", ("agree", "net", "1w", "full"),
+         "comma list of probe parts",
+         "Which relayout-probe parts to run.", _csv("PROBE_PARTS"))
+register("FIX_PARTS", "csv", ("uniform", "runs16", "exact"),
+         "comma list of probe parts",
+         "Which fixdepth-probe parts to run.", _csv("FIX_PARTS"))
+
+# Infrastructure pass-throughs and native-consumed knobs.
+
+register("XLA_FLAGS", "str", "", "XLA flag string (pass-through)",
+         "Extra XLA flags; utils/platform.py appends the device-count flag.",
+         _passthrough)
+register("COMM_RANKS", "int", None, "a positive integer",
+         "Rank count for the native pthreads (local) comm backend.",
+         _passthrough, consumer="native")
+register("COMM_STATS", "path", None, "a writable file path",
+         "Native backends append one comm-stats JSON line per run here.",
+         _passthrough, consumer="native")
+register("COMM_FAULTS", "spec", None,
+         "kill:<rank>@<nth> | stall:<rank>@<nth>:<ms>",
+         "Native fault drills at collective entry (comm/comm_faults.h).",
+         _passthrough, consumer="native")
+register("MINIMPI_NP", "int", None, "a positive integer",
+         "Process count for the fork-based minimpi runtime.",
+         _passthrough, consumer="native")
+
+
+# ----------------------------------------------------------------- table
+
+def reference_table() -> str:
+    """The knob reference as a markdown table — the generated source of
+    README's "Environment knobs" section (``make knob-docs`` regenerates
+    it; a registered knob missing from README fails sortlint SL031)."""
+    rows = ["| knob | type | default | validates | description |",
+            "|---|---|---|---|---|"]
+    for k in iter_knobs():
+        if k.name == "SORT_INGEST_CHUNK":
+            # registered default is None (= "use the constant"); the
+            # table shows the effective value
+            default = str(DEFAULT_INGEST_CHUNK) + " (2^22)"
+        elif k.default is None:
+            default = "_(unset)_"
+        elif isinstance(k.default, bool):
+            default = "1" if k.default else "0"
+        elif isinstance(k.default, tuple):
+            default = ",".join(k.default)
+        else:
+            default = str(k.default)
+        doc = k.doc + (" _(consumed by the C backends)_"
+                       if k.consumer == "native" else "")
+        spec = k.spec.replace("|", "\\|")  # literal pipes inside md cells
+        rows.append(f"| `{k.name}` | {k.kind} | {default} | {spec} | {doc} |")
+    return "\n".join(rows)
+
+
+def main() -> int:
+    print(reference_table())
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

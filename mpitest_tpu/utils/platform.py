@@ -85,8 +85,10 @@ def ensure_virtual_cpu_devices(n_devices: int) -> None:
             "run in a fresh process with "
             f"XLA_FLAGS=--xla_force_host_platform_device_count={n_devices}"
         )
+    from mpitest_tpu.utils import knobs
+
     os.environ["XLA_FLAGS"] = (
-        os.environ.get("XLA_FLAGS", "")
+        knobs.get("XLA_FLAGS")
         + f" --xla_force_host_platform_device_count={n_devices}"
     )
     jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,93 @@
+"""Registered span-name schema — the vocabulary contract of the telemetry layer.
+
+``report.py`` aggregates spans **by string match** (phase tables, the
+collective table via ``MPI_EQUIV``, the robustness table, the ingest
+overlap gate).  Before this module, a renamed span silently vanished
+from those tables: the producer compiled, the tests that grep'd other
+names passed, and the telemetry got quietly poorer.  Now every span
+name a producer may emit is registered HERE, report.py consumes the
+same constants, and ``tools/sortlint`` rule ``SL003 span-name`` fails
+the lint gate on any literal span name outside the registry — a rename
+must touch this file, which is exactly where the report aggregations
+look.
+
+Two name classes:
+
+* **exact names** (:data:`SPAN_NAMES`) — every key maps to a one-line
+  doc of what the span means and who emits it;
+* **phase names** (:data:`PHASE_NAMES`) — ``Tracer.phase(name)`` emits
+  ``phase:<name>``; the report's per-phase table keys on the suffix.
+
+This module is import-light on purpose (stdlib only): sortlint loads it
+without pulling jax/numpy, so the lint CI job needs no device stack.
+"""
+
+from __future__ import annotations
+
+#: ``Tracer.phase(name)`` vocabulary → ``phase:<name>`` spans, summed
+#: into the report's per-phase wall-time table.
+PHASE_NAMES: frozenset[str] = frozenset({
+    "sort",        # SPMD program dispatch + execution
+    "encode",      # host-side key codec encode
+    "device_put",  # host→device placement (monolithic path)
+    "decode",      # device→host decode of the sorted words
+    "verify",      # always-on output verification (ISSUE 3)
+    "ingest",      # streamed ingest pipeline region
+    "plan",        # pass/splitter planning
+})
+
+#: Prefix of every phase span (``Tracer.phase`` is the only producer).
+PHASE_PREFIX = "phase:"
+
+#: Exact span/event names → one-line doc.  Grouped by producer.
+SPAN_NAMES: dict[str, str] = {
+    # models/api.py — run umbrellas and the jit split
+    "sort": "one sort() run (umbrella span; device-mem high-water attr)",
+    "ingest": "one ingest_to_mesh() run (umbrella span)",
+    "jit_compile_execute": "first call of a jit program (trace+compile+run)",
+    "jit_execute": "warm call of a jit program",
+    # models/* — trace-time algorithm structure
+    "radix_pass": "one LSD radix pass (trace-time, per compile)",
+    "splitter_round": "one sample-sort splitter round (trace-time)",
+    # parallel/collectives.py — trace-time collective byte accounting
+    "all_gather": "lax.all_gather point event (bytes, ranks)",
+    "psum": "lax.psum point event (bytes, op=sum)",
+    "pmax": "lax.pmax point event (bytes, op=max)",
+    "ragged_all_to_all": "padded alltoallv exchange (bytes, wire_bytes, cap)",
+    # robustness vocabulary (ISSUE 3)
+    "fault": "one injected fault firing (site, seq)",
+    "supervisor_retry": "one retried SPMD dispatch (label, attempt, error)",
+    "verify": "one output verification (ok, sorted_ok, fp_ok)",
+    # models/ingest.py — streamed pipeline stages (ISSUE 2)
+    "ingest.parse": "parse/materialize one host chunk",
+    "ingest.encode": "codec-encode one chunk (worker pool)",
+    "ingest.transfer": "host→device DMA of one chunk's shard pieces",
+    "ingest.pipeline": "whole streamed-ingest wall interval",
+    "egress.fetch": "device→host fetch of one result shard",
+    "egress.decode": "codec-decode one fetched shard",
+}
+
+#: Ingest/egress stage split used by the report overlap tables: host-side
+#: work vs host↔device transfer, per direction (the span name's prefix).
+INGEST_HOST_STAGES = ("ingest.parse", "ingest.encode", "egress.decode")
+INGEST_XFER_STAGES = ("ingest.transfer", "egress.fetch")
+
+#: Robustness event names the report's robustness table folds.
+FAULT_SPAN = "fault"
+RETRY_SPAN = "supervisor_retry"
+VERIFY_SPAN = "verify"
+
+
+def is_registered(name: str) -> bool:
+    """True iff ``name`` is a registered span name (exact, or a
+    ``phase:`` span over a registered phase)."""
+    if name in SPAN_NAMES:
+        return True
+    return (name.startswith(PHASE_PREFIX)
+            and name[len(PHASE_PREFIX):] in PHASE_NAMES)
+
+
+def all_names() -> tuple[str, ...]:
+    """Every registered name, phases expanded — for docs and tests."""
+    return tuple(sorted(SPAN_NAMES)) + tuple(
+        sorted(PHASE_PREFIX + p for p in PHASE_NAMES))

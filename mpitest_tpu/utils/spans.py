@@ -56,6 +56,7 @@ import threading
 import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
+from typing import Iterator
 
 #: In-memory retention cap per SpanLog.  phases/counters accumulate by
 #: design across runs on a reused Tracer, but retaining every span of
@@ -81,11 +82,11 @@ MPI_EQUIV = {
 }
 
 
-def merge_intervals(iv: list) -> list:
+def merge_intervals(iv: list[tuple[float, float]]) -> list[tuple[float, float]]:
     """Sorted, coalesced ``(t0, t1)`` intervals — shared by the report
     CLI's overlap tables and the ingest pipeline's own stats, so both
     compute 'host work ∩ transfer' identically."""
-    out: list = []
+    out: list[list[float]] = []
     for a, b in sorted(iv):
         if out and a <= out[-1][1]:
             out[-1][1] = max(out[-1][1], b)
@@ -94,7 +95,8 @@ def merge_intervals(iv: list) -> list:
     return [(a, b) for a, b in out]
 
 
-def overlap_seconds(a: list, b: list) -> float:
+def overlap_seconds(a: list[tuple[float, float]],
+                    b: list[tuple[float, float]]) -> float:
     """Total intersection of two MERGED interval lists — the wall-clock
     seconds the two activities genuinely ran concurrently.  Clocks are
     process-relative ``perf_counter``, so this is only meaningful for
@@ -123,9 +125,9 @@ class Span:
     parent: int | None
     t0: float               # seconds, process-relative (perf_counter)
     dt: float = 0.0
-    attrs: dict = field(default_factory=dict)
+    attrs: dict[str, object] = field(default_factory=dict)
 
-    def to_dict(self) -> dict:
+    def to_dict(self) -> dict[str, object]:
         # pid scopes the process-relative perf_counter timeline: rows
         # appended to one SORT_TRACE file by different runs must never
         # be compared on t0 (report.py groups overlap math by it).
@@ -147,7 +149,7 @@ def current_log() -> "SpanLog | None":
     return _ACTIVE[-1] if _ACTIVE else None
 
 
-def emit(name: str, **attrs) -> None:
+def emit(name: str, **attrs: object) -> None:
     """Record a point event on the active SpanLog (no-op when tracing is
     off) — the one-line hook the parallel/model layers call."""
     log = current_log()
@@ -155,7 +157,9 @@ def emit(name: str, **attrs) -> None:
         log.event(name, **attrs)
 
 
-def maybe_span(name: str, **attrs):
+def maybe_span(
+    name: str, **attrs: object,
+) -> "contextlib.AbstractContextManager[Span | None]":
     """Span twin of :func:`emit`: a span on the active log, or a no-op
     context manager when tracing is off — what instrumented SPMD model
     code opens around trace-time regions (radix passes, splitter
@@ -174,7 +178,7 @@ class SpanLog:
     the spans still open, and multiple runs append like any JSONL).
     """
 
-    def __init__(self, stream_path: str | None = None):
+    def __init__(self, stream_path: str | None = None) -> None:
         self.spans: list[Span] = []
         self.stream_path = stream_path
         self.dropped = 0       # spans past MAX_RETAINED_SPANS (streamed only)
@@ -186,8 +190,8 @@ class SpanLog:
         self._lock = threading.Lock()
 
     # -- recording ----------------------------------------------------
-    def _new(self, name: str, attrs: dict, t0: float | None = None,
-             dt: float = 0.0) -> Span:
+    def _new(self, name: str, attrs: dict[str, object],
+             t0: float | None = None, dt: float = 0.0) -> Span:
         with self._lock:
             s = Span(
                 name=name, id=self._next_id,
@@ -205,7 +209,8 @@ class SpanLog:
             else:
                 self.dropped += 1
 
-    def record(self, name: str, t0: float, dt: float, **attrs) -> Span:
+    def record(self, name: str, t0: float, dt: float,
+               **attrs: object) -> Span:
         """Thread-safe completed-span recording — the entry point for
         pipeline worker threads (ingest/egress stages), which time their
         own intervals and report them here after the fact.  Parents
@@ -216,7 +221,7 @@ class SpanLog:
         self._flush(s)
         return s
 
-    def event(self, name: str, **attrs) -> Span:
+    def event(self, name: str, **attrs: object) -> Span:
         """Point event (dt=0) under the currently open span."""
         s = self._new(name, attrs)
         self._retain(s)
@@ -224,7 +229,7 @@ class SpanLog:
         return s
 
     @contextmanager
-    def span(self, name: str, **attrs):
+    def span(self, name: str, **attrs: object) -> Iterator[Span]:
         """Timed interval; nests under the enclosing open span.  The
         outermost span activates this log for module-level `emit()`."""
         s = self._new(name, attrs)
@@ -261,7 +266,7 @@ class SpanLog:
             with open(path, "a") as f:
                 f.write(self.to_jsonl() + "\n")
 
-    def to_chrome_trace(self) -> dict:
+    def to_chrome_trace(self) -> dict[str, object]:
         """Chrome trace-event JSON (loads in chrome://tracing/Perfetto).
 
         Timed spans become ``"ph": "X"`` complete events; point events

@@ -65,7 +65,7 @@ static void run(comm_ctx *c, void *arg) {
 
     fuzz_state f = {
         .shared = seed * 0x2545F4914F6CDD1Dull + 1,
-        .mine = seed ^ (0xA24BAED4963EE407ull * (uint64_t)(rank + 1)),
+        .mine = seed ^ ((uint64_t)0xA24BAED4963EE407ull * (uint64_t)(rank + 1)),
         .check = 0,
         .pos = 0,
     };

@@ -1,0 +1,262 @@
+"""Tests for the static-analysis subsystem (ISSUE 4): sortlint rules on
+good/bad fixture snippets, the knob registry's contracts, the span
+schema, the comm parity checker, and the repo-wide dogfood run.
+
+Named ``test_zz_*`` to sort LAST: tier-1 is timeout-bound and
+dots-counted, and everything here is pure ast/text/registry work (no
+jit compiles), so the whole module stays in low single-digit seconds.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+from tools import comm_parity  # noqa: E402
+from tools.sortlint import (  # noqa: E402
+    LINT_VERSION, RULES, lint_repo, lint_source)
+
+from mpitest_tpu.utils import knobs, span_schema  # noqa: E402
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ------------------------------------------------------------- fixtures
+
+def test_sl001_env_read_flagged_writes_allowed():
+    bad = "import os\nv = os.environ.get('SORT_ALGO', 'sample')\n"
+    assert rules_of(lint_source(bad, "mpitest_tpu/x.py")) == ["SL001"]
+    bad2 = "import os\nv = os.getenv('SORT_ALGO')\n"
+    assert rules_of(lint_source(bad2, "x.py")) == ["SL001"]
+    bad3 = "import os\nv = os.environ['SORT_ALGO']\n"
+    assert rules_of(lint_source(bad3, "x.py")) == ["SL001"]
+    # writes and subprocess-env construction stay legal
+    good = ("import os\nos.environ['A'] = '1'\n"
+            "os.environ.setdefault('B', '2')\ndel os.environ['A']\n"
+            "env = dict(os.environ, C='3')\n")
+    assert lint_source(good, "x.py") == []
+    # the registry itself is exempt (it IS the sanctioned reader)
+    assert lint_source(bad, "mpitest_tpu/utils/knobs.py") == []
+
+
+def test_sl002_span_requires_with():
+    bad = "s = tracer.spans.span('sort')\n"
+    assert "SL002" in rules_of(lint_source(bad, "x.py"))
+    good = "with tracer.spans.span('sort'):\n    pass\n"
+    assert lint_source(good, "x.py") == []
+    # wrapper idiom: returning the context manager is allowed
+    wrapper = ("def f():\n    return spans.maybe_span('radix_pass')\n")
+    assert lint_source(wrapper, "x.py") == []
+
+
+def test_sl003_span_names_come_from_schema():
+    bad = "with tracer.spans.span('totally_new_span'):\n    pass\n"
+    assert rules_of(lint_source(bad, "x.py")) == ["SL003"]
+    bad_phase = "with tracer.phase('warp'):\n    pass\n"
+    assert rules_of(lint_source(bad_phase, "x.py")) == ["SL003"]
+    good = ("with tracer.phase('sort'):\n"
+            "    with tracer.spans.span('radix_pass'):\n        pass\n")
+    assert lint_source(good, "x.py") == []
+    nonliteral = "with tracer.spans.span(name):\n    pass\n"
+    assert rules_of(lint_source(nonliteral, "x.py")) == ["SL003"]
+
+
+def test_sl000_suppression_needs_reason():
+    sup_ok = ("with tracer.spans.span(n):  "
+              "# sortlint: disable=SL003 -- n is provably registered\n"
+              "    pass\n")
+    assert lint_source(sup_ok, "x.py") == []
+    # a reasonless directive does NOT suppress: the original finding
+    # survives and the directive itself is flagged
+    sup_bad = ("with tracer.spans.span(n):  # sortlint: disable=SL003\n"
+               "    pass\n")
+    assert rules_of(lint_source(sup_bad, "x.py")) == ["SL000", "SL003"]
+
+
+def test_sl010_lax_reduce_banned():
+    bad = "import jax\nout = jax.lax.reduce(x, 0, op, (0,))\n"
+    assert rules_of(lint_source(bad, "x.py")) == ["SL010"]
+    good = "import jax.numpy as jnp\nout = jnp.sum(x)\n"
+    assert lint_source(good, "x.py") == []
+
+
+def test_sl011_bare_device_put():
+    bad = "import jax\ny = jax.device_put(x, dev)\n"
+    assert rules_of(lint_source(bad, "x.py")) == ["SL011"]
+    # ... except inside the guard's own definition
+    good = ("def checked_device_put(x, t):\n"
+            "    import jax\n    return jax.device_put(x, t)\n")
+    assert lint_source(good, "x.py") == []
+
+
+def test_sl012_host_sync_inside_traced_fn():
+    bad = ("import jax\nimport numpy as np\n"
+           "def f(x):\n    return np.asarray(x) + 1\n"
+           "g = jax.jit(f)\n")
+    assert "SL012" in rules_of(lint_source(bad, "x.py"))
+    bad2 = ("import jax\n"
+            "def f(x):\n    x.block_until_ready()\n    return x\n"
+            "g = jax.jit(f)\n")
+    assert "SL012" in rules_of(lint_source(bad2, "x.py"))
+    # the same calls OUTSIDE traced functions are fine
+    good = ("import numpy as np\n"
+            "def h(x):\n    return np.asarray(x)\n")
+    assert lint_source(good, "x.py") == []
+
+
+def test_sl040_typed_core_annotations():
+    bad = "def f(x):\n    return x\n"
+    path = "mpitest_tpu/models/newmod.py"
+    assert rules_of(lint_source(bad, path)) == ["SL040"]
+    good = "def f(x: int) -> int:\n    return x\n"
+    assert lint_source(good, path) == []
+    # nested defs (jit bodies) are exempt by design
+    nested = ("def outer() -> object:\n"
+              "    def f(x):\n        return x\n    return f\n")
+    assert lint_source(nested, path) == []
+    # ...and the same file outside the typed core is untouched
+    assert lint_source(bad, "bench/newprobe.py") == []
+
+
+# ------------------------------------------------------------- dogfood
+
+def test_repo_lints_clean():
+    """The acceptance gate, as a test: 0 findings over the whole repo.
+    Pure ast — this is the expensive-looking assertion that actually
+    runs in ~a second."""
+    findings = lint_repo(REPO)
+    assert findings == [], "\n".join(f.render() for f in findings)
+    assert len(RULES) >= 10
+    assert LINT_VERSION.startswith("sortlint.")
+
+
+# ------------------------------------------------------ parity checker
+
+def test_comm_parity_clean_and_catches_rank_conditional(tmp_path):
+    assert comm_parity.main() == 0
+    bad = tmp_path / "bad_sorter.c"
+    bad.write_text(
+        "void run(comm_ctx *c) {\n"
+        "    int rank = comm_rank(c);\n"
+        "    if (rank == 0) {\n"
+        "        comm_barrier(c);\n"
+        "    }\n"
+        "}\n")
+    findings = comm_parity.check_rank_conditional_collectives(bad)
+    assert findings and "comm_barrier" in findings[0]
+    ok = tmp_path / "ok_sorter.c"
+    ok.write_text(
+        "void run(comm_ctx *c) {\n"
+        "    comm_barrier(c);\n"
+        "    if (rank == 0) { printf(\"root\\n\"); }\n"
+        "}\n")
+    assert comm_parity.check_rank_conditional_collectives(ok) == []
+
+
+def test_comm_parity_sequences_cover_both_sorters():
+    seq_r = comm_parity.collective_sequence(REPO / "native" / "radix_sort.c")
+    seq_s = comm_parity.collective_sequence(REPO / "native" / "sample_sort.c")
+    assert seq_r[0] == "comm_bcast" and "comm_gatherv" in seq_r
+    assert "comm_alltoallv" in seq_s
+
+
+# ------------------------------------------------------- knob registry
+
+def test_knob_registry_validation_contracts(monkeypatch):
+    monkeypatch.setenv("SORT_MAX_RETRIES", "-1")
+    with pytest.raises(ValueError, match="SORT_MAX_RETRIES"):
+        knobs.get("SORT_MAX_RETRIES")
+    monkeypatch.setenv("SORT_CAP_FACTOR", "nan")
+    with pytest.raises(ValueError, match="finite number > 0"):
+        knobs.get("SORT_CAP_FACTOR")
+    monkeypatch.setenv("SORT_FALLBACK", "yes")
+    with pytest.raises(ValueError, match="SORT_FALLBACK"):
+        knobs.get("SORT_FALLBACK")
+    monkeypatch.delenv("SORT_FALLBACK")
+    assert knobs.get("SORT_FALLBACK") is True
+    monkeypatch.setenv("BENCH_PLATFORM", "gpu:2")
+    with pytest.raises(ValueError, match="cpu"):
+        knobs.get("BENCH_PLATFORM")
+    monkeypatch.setenv("BENCH_PLATFORM", "cpu:4")
+    assert knobs.get("BENCH_PLATFORM") == 4
+    # unregistered names are a hard error, not a silent None
+    with pytest.raises(KeyError):
+        knobs.get("SORT_NOT_A_KNOB")
+    with pytest.raises(KeyError):
+        knobs.get_raw("SORT_NOT_A_KNOB")
+
+
+def test_knob_scoped_env_restores(monkeypatch):
+    monkeypatch.setenv("SORT_ALGO", "radix")
+    with knobs.scoped_env(SORT_ALGO="sample", SORT_RANKS="4"):
+        assert knobs.get("SORT_ALGO") == "sample"
+        assert knobs.get("SORT_RANKS") == 4
+    assert knobs.get("SORT_ALGO") == "radix"
+    assert knobs.get("SORT_RANKS") is None
+    with knobs.scoped_env(SORT_ALGO=None):
+        assert knobs.get("SORT_ALGO") == "sample"  # default when unset
+    assert knobs.get("SORT_ALGO") == "radix"
+
+
+def test_knob_reference_table_matches_readme():
+    """README embeds the GENERATED table — drift fails here and in
+    sortlint SL031."""
+    table = knobs.reference_table()
+    readme = (REPO / "README.md").read_text()
+    for k in knobs.iter_knobs():
+        assert f"`{k.name}`" in table
+        assert f"`{k.name}`" in readme
+    # the embedded block is byte-identical to the generator's output
+    assert table in readme
+
+
+def test_knob_cli_prints_table():
+    out = subprocess.run(
+        [sys.executable, "-m", "mpitest_tpu.utils.knobs"],
+        capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 0
+    assert "| `SORT_ALGO` |" in out.stdout
+
+
+# ---------------------------------------------------------- span schema
+
+def test_span_schema_registry():
+    assert span_schema.is_registered("sort")
+    assert span_schema.is_registered("phase:verify")
+    assert span_schema.is_registered("ingest.transfer")
+    assert not span_schema.is_registered("phase:warp")
+    assert not span_schema.is_registered("made_up")
+    assert set(span_schema.INGEST_HOST_STAGES) <= set(span_schema.SPAN_NAMES)
+    # every registered name carries a nonempty doc
+    assert all(doc for doc in span_schema.SPAN_NAMES.values())
+
+
+def test_report_flags_unregistered_span(tmp_path):
+    from mpitest_tpu import report
+
+    f = tmp_path / "t.jsonl"
+    f.write_text('{"v": "span.v1", "name": "mystery", "id": 0, '
+                 '"parent": null, "t0": 0.0, "dt": 0.1, "attrs": {}}\n')
+    assert report.main(["--check", str(f)]) == 0
+    assert report.main(["--check", "--require-registered-spans",
+                        str(f)]) == 1
+
+
+# ------------------------------------------------------ tooling state
+
+def test_bench_row_tooling_state():
+    import bench
+
+    t = bench.tooling_state()
+    assert t["sortlint"] == LINT_VERSION
+    assert t["sortlint_rules"] == len(RULES)
+    assert "-Wconversion" in t["cwarn"]
+    assert "tsan" in t["sanitize"]

@@ -1,0 +1,202 @@
+#!/usr/bin/env python3
+"""Cross-backend comm-layer parity checker (the static half of `make lint`).
+
+The native comm surface exists in FIVE places that must agree and that
+nothing cross-checked until now:
+
+* ``comm/comm.h`` — the declared ``comm_*`` API;
+* ``comm/comm_local.c`` and ``comm/comm_mpi.c`` — the two backends, each
+  of which must define every declared symbol (a missing definition only
+  surfaces when some program first links it — possibly in the one CI job
+  with a real MPI install);
+* ``comm/mpi_stub/mpi.h`` + ``mpi_mock.c`` + ``minimpi.c`` — every
+  ``MPI_*`` function the MPI backend calls must be declared in the
+  vendored stub and implemented by BOTH mock runtimes, or the
+  MPI-without-MPI builds rot silently.
+
+It also extracts the collective call-sequence from each native sorter
+and flags the classic static deadlock smell: a collective call inside a
+rank-conditional branch (``if (rank == ...) comm_barrier(...)`` hangs
+every other rank forever — the reference's stranded-peer failure shape,
+SURVEY §7.4).  Genuinely-safe cases carry an inline
+``/* parity: ok -- <reason> */`` on the same line.
+
+Pure text/regex over the C sources — no compiler needed; runs in the CI
+lint job.  Exit 0 clean / 1 on mismatches (printed one per line).
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+#: comm.h symbols every backend must define.
+_DECL_RE = re.compile(r"^\s*(?:int|void|double)\s+(comm_\w+)\s*\(",
+                      re.MULTILINE)
+
+#: A function DEFINITION: return type + name + ( ... with no trailing ';'
+#: on the prototype line run (brace may sit on a later line).
+def _defined_symbols(src: str) -> set[str]:
+    out = set()
+    for m in re.finditer(
+            r"^[A-Za-z_][\w\s\*]*?\b(comm_\w+|MPI_\w+)\s*\(", src,
+            re.MULTILINE):
+        # walk to the matching ')' then check for '{' (definition) vs ';'
+        i = m.end() - 1
+        depth = 0
+        while i < len(src):
+            if src[i] == "(":
+                depth += 1
+            elif src[i] == ")":
+                depth -= 1
+                if depth == 0:
+                    break
+            i += 1
+        rest = src[i + 1:i + 80].lstrip()
+        if rest.startswith("{"):
+            out.add(m.group(1))
+    return out
+
+
+#: Collectives (entered by every rank together); rooted or not, ALL of
+#: them block in both backends, so a rank-conditional call is a hang.
+_COLLECTIVES = ("comm_barrier", "comm_bcast", "comm_scatter",
+                "comm_scatterv", "comm_gather", "comm_gatherv",
+                "comm_allgather", "comm_allreduce", "comm_exscan",
+                "comm_alltoall", "comm_alltoallv")
+
+_RANK_COND_RE = re.compile(
+    r"if\s*\([^)]*\b(rank|RANK|me|myid)\b[^)]*\)")
+
+_OK_RE = re.compile(r"/\*\s*parity:\s*ok\s*--\s*\S[^*]*\*/")
+
+
+def _strip_comments(src: str) -> str:
+    # newline-preserving blanking, so line numbers survive the strip
+    blank = lambda m: re.sub(r"[^\n]", " ", m.group())  # noqa: E731
+    src = re.sub(r"/\*.*?\*/", blank, src, flags=re.S)
+    return re.sub(r"//[^\n]*", blank, src)
+
+
+def _brace_depth_prefix(src: str) -> list[int]:
+    depth, out = 0, []
+    for ch in src:
+        out.append(depth)
+        if ch == "{":
+            depth += 1
+        elif ch == "}":
+            depth -= 1
+    return out
+
+
+def check_rank_conditional_collectives(path: Path) -> list[str]:
+    """Flag collective calls lexically inside a rank-conditional block.
+
+    Heuristic: a collective call whose enclosing brace depth is deeper
+    than the nearest preceding rank-test ``if`` at a shallower depth,
+    within the same function.  Conservative (single-statement ifs
+    without braces are caught by same-line/next-line adjacency)."""
+    raw = path.read_text()
+    src = _strip_comments(raw)
+    lines = raw.splitlines()
+    findings = []
+    for m in re.finditer(r"\b(" + "|".join(_COLLECTIVES) + r")\s*\(", src):
+        lineno = src.count("\n", 0, m.start()) + 1
+        # window: the preceding ~6 lines; a rank-conditional guard there
+        # with an unclosed brace (or same/previous line, unbraced) is
+        # the smell.  Suppression comment on the call line passes it.
+        if _OK_RE.search(lines[lineno - 1]):
+            continue
+        window_start = max(0, lineno - 7)
+        window = "\n".join(lines[window_start:lineno])
+        for g in _RANK_COND_RE.finditer(window):
+            tail = window[g.end():]
+            # guard still open if no '}' closed it before the call
+            if tail.count("}") < tail.count("{") or \
+                    ("{" not in tail and "}" not in tail
+                     and tail.strip().count(";") == 0):
+                findings.append(
+                    f"{path.name}:{lineno}: {m.group(1)} under a "
+                    "rank-conditional branch — static deadlock smell "
+                    "(peers block in a collective this rank may skip); "
+                    "annotate `/* parity: ok -- <reason> */` if every "
+                    "rank provably takes the same branch")
+                break
+    return findings
+
+
+def collective_sequence(path: Path) -> list[str]:
+    src = _strip_comments(path.read_text())
+    return [m.group(1) for m in
+            re.finditer(r"\b(" + "|".join(_COLLECTIVES) + r")\s*\(", src)]
+
+
+def main() -> int:
+    errors: list[str] = []
+
+    comm_h = (REPO / "comm" / "comm.h").read_text()
+    declared = sorted(set(_DECL_RE.findall(comm_h)))
+    if not declared:
+        errors.append("comm/comm.h: no comm_* declarations parsed")
+
+    backends = {
+        "comm/comm_local.c": _defined_symbols(
+            (REPO / "comm" / "comm_local.c").read_text()),
+        "comm/comm_mpi.c": _defined_symbols(
+            (REPO / "comm" / "comm_mpi.c").read_text()),
+    }
+    for backend, defined in backends.items():
+        for sym in declared:
+            if sym not in defined:
+                errors.append(f"{backend}: declared symbol {sym} has no "
+                              "definition in this backend")
+
+    # MPI surface: calls made by comm_mpi.c must exist in the stub header
+    # and in both mock runtimes.
+    mpi_src = _strip_comments((REPO / "comm" / "comm_mpi.c").read_text())
+    called = sorted({m.group(1) for m in
+                     re.finditer(r"\b(MPI_[A-Z]\w+)\s*\(", mpi_src)})
+    stub_h = (REPO / "comm" / "mpi_stub" / "mpi.h").read_text()
+    mock = _defined_symbols((REPO / "comm" / "mpi_stub" / "mpi_mock.c")
+                            .read_text())
+    mini = _defined_symbols((REPO / "comm" / "mpi_stub" / "minimpi.c")
+                            .read_text())
+    for fn in called:
+        if not re.search(r"\b" + fn + r"\s*\(", stub_h):
+            errors.append(f"comm/mpi_stub/mpi.h: {fn} (called by "
+                          "comm_mpi.c) is not declared in the stub")
+        for name, impl in (("mpi_mock.c", mock), ("minimpi.c", mini)):
+            if fn not in impl:
+                errors.append(f"comm/mpi_stub/{name}: {fn} (called by "
+                              "comm_mpi.c) is not implemented")
+
+    # Sorter call-sequences + the deadlock smell.
+    for sorter in ("native/sample_sort.c", "native/radix_sort.c"):
+        p = REPO / sorter
+        seq = collective_sequence(p)
+        # every comm_* symbol a sorter calls must exist in the declared
+        # API — a private backend helper leaking into a sorter would
+        # link against one backend and not the other
+        calls = {m.group(1) for m in re.finditer(
+            r"\b(comm_\w+)\s*\(", _strip_comments(p.read_text()))}
+        undeclared = sorted(calls - set(declared))
+        if undeclared:
+            errors.append(f"{sorter}: calls comm_* symbols not declared "
+                          f"in comm/comm.h: {undeclared}")
+        errors.extend(check_rank_conditional_collectives(p))
+        print(f"{sorter}: {len(seq)} collective calls "
+              f"({' -> '.join(dict.fromkeys(seq))})")
+
+    for e in errors:
+        print(f"[PARITY] {e}", file=sys.stderr)
+    print(f"comm parity: {len(errors)} mismatch(es); "
+          f"{len(declared)} comm.h symbols x {len(backends)} backends, "
+          f"{len(called)} MPI calls x 2 runtimes checked")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,178 @@
+"""sortlint — the project's custom AST linter (stdlib ``ast``, zero deps).
+
+Generic linters check style; this one checks the **project invariants**
+that PRs 1-3 accumulated and that nothing enforced mechanically until
+now.  Each rule encodes one hard-won lesson:
+
+========  =================================================================
+SL001     env knobs are read ONLY through ``mpitest_tpu/utils/knobs.py``
+          (typed, validated, self-documenting); scattered ``os.environ``
+          reads are where unvalidated garbage enters.  Writes stay legal.
+SL002     spans are opened only as context managers (``with ...span(...)``)
+          — an un-entered span silently records nothing.
+SL003     literal span/phase names must come from the registered schema
+          (``utils/span_schema.py``) that report.py aggregates by — a
+          renamed span must fail the lint, not silently vanish from the
+          telemetry tables.
+SL010     no ``lax.reduce`` — custom reduction computations are
+          UNIMPLEMENTED under the SPMD partitioner (CHANGES.md, PR 3);
+          use halving folds / jnp reductions.
+SL011     no bare ``jax.device_put`` — ``checked_device_put`` exists
+          because a silent dtype downcast produced a wrong sort once
+          (bench.py:171, PR 2); the guard is mandatory.
+SL012     no host syncs (``np.asarray`` / ``np.array`` /
+          ``jax.device_get`` / ``.block_until_ready`` / ``.item``)
+          inside functions that are jitted or shard_map'ed — they poison
+          the trace or force mid-program round-trips.
+SL020     fault-registry completeness: every ``faults.SITES`` entry is
+          exercised by ``bench/fault_selftest.py``; every COMM_FAULTS
+          kind in ``comm/comm_faults.h`` is hooked in BOTH C backends
+          and drilled by the selftest.
+SL030     every registered knob carries a nonempty one-line doc.
+SL031     every registered knob appears in README's reference table.
+SL040     the typed core (``models/``, ``parallel/``, ``utils/spans.py``,
+          ``faults.py``) carries full signature annotations — the
+          in-container proxy for the mypy strict gate (mypy itself runs
+          in CI's lint job and wherever installed).
+========  =================================================================
+
+Suppressions are explicit and must carry a reason::
+
+    something_flagged()  # sortlint: disable=SL003 -- why this is safe
+
+A directive without a reason is itself a finding (SL000).  The linter
+imports nothing from the package under lint (pure ``ast`` + text), so
+the CI lint job needs no jax/numpy stack.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Callable, Iterable
+
+#: Bumped when rules change meaningfully; recorded in bench run metadata
+#: so BENCH rows are attributable to a tooling state.
+LINT_VERSION = "sortlint.v1"
+
+#: Default lint targets relative to the repo root.  tests/ is excluded
+#: on purpose: fixture snippets there exist to VIOLATE the rules.
+DEFAULT_TARGETS = ("mpitest_tpu", "drivers", "tools", "bench.py", "bench")
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*sortlint:\s*disable=(?P<ids>[A-Z0-9, ]+?)"
+    r"(?:\s*--\s*(?P<reason>.*\S))?\s*$")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    msg: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.msg}"
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    name: str
+    doc: str
+    #: check(path, src, tree) -> findings; ``tree`` is None for repo
+    #: rules (scope == "repo"), which run once with path = repo root.
+    check: Callable[[str, str, ast.AST | None], list[Finding]]
+    scope: str = "file"  # "file" | "repo"
+
+
+def _suppressions(src: str) -> dict[int, tuple[set[str], str | None]]:
+    """line -> (rule ids, reason) for every suppression directive."""
+    out: dict[int, tuple[set[str], str | None]] = {}
+    for i, line in enumerate(src.splitlines(), 1):
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            ids = {t.strip() for t in m.group("ids").split(",") if t.strip()}
+            out[i] = (ids, m.group("reason"))
+    return out
+
+
+def apply_suppressions(src: str, findings: list[Finding],
+                       path: str) -> list[Finding]:
+    """Drop findings suppressed on their own line (or the line above);
+    emit SL000 for directives missing a reason — a suppression is an
+    inline design note, not a mute button."""
+    sup = _suppressions(src)
+    out = []
+    for i, (ids, reason) in sup.items():
+        if reason is None:
+            out.append(Finding(
+                "SL000", path, i,
+                f"suppression of {','.join(sorted(ids))} has no reason; "
+                "write `# sortlint: disable=<ID> -- <why>`"))
+    for f in findings:
+        killed = False
+        for ln in (f.line, f.line - 1):
+            entry = sup.get(ln)
+            if entry and f.rule in entry[0] and entry[1]:
+                killed = True
+                break
+        if not killed:
+            out.append(f)
+    return out
+
+
+# Rule registration happens in tools/sortlint/rules.py (imported at the
+# bottom of this module to avoid a cycle: rules need Finding).
+RULES: list[Rule] = []
+
+
+def register(rule: Rule) -> None:
+    RULES.append(rule)
+
+
+def lint_source(src: str, path: str = "<snippet>",
+                rules: Iterable[str] | None = None) -> list[Finding]:
+    """Lint one source string (the test harness entry point)."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Finding("SL999", path, e.lineno or 0, f"syntax error: {e.msg}")]
+    findings: list[Finding] = []
+    for rule in RULES:
+        if rule.scope != "file":
+            continue
+        if rules is not None and rule.id not in rules:
+            continue
+        findings.extend(rule.check(path, src, tree))
+    return apply_suppressions(src, findings, path)
+
+
+def iter_target_files(root: Path, targets: Iterable[str]) -> list[Path]:
+    files: list[Path] = []
+    for t in targets:
+        p = root / t
+        if p.is_file() and p.suffix == ".py":
+            files.append(p)
+        elif p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+    return files
+
+
+def lint_repo(root: str | Path = ".",
+              targets: Iterable[str] = DEFAULT_TARGETS) -> list[Finding]:
+    """Lint the repo: file rules over ``targets`` + repo rules once."""
+    root = Path(root)
+    findings: list[Finding] = []
+    for f in iter_target_files(root, targets):
+        rel = str(f.relative_to(root))
+        findings.extend(lint_source(f.read_text(), rel))
+    for rule in RULES:
+        if rule.scope == "repo":
+            findings.extend(rule.check(str(root), "", None))
+    return findings
+
+
+from tools.sortlint import rules as _rules  # noqa: E402,F401  (registers RULES)

@@ -1,0 +1,40 @@
+"""CLI: ``python -m tools.sortlint [--root DIR] [targets...]``.
+
+Exit 0 on a clean run, 1 on findings — the `make lint` contract.
+``--list-rules`` prints the rule census (the count is also recorded in
+bench run metadata so BENCH rows are attributable to a tooling state).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tools.sortlint import DEFAULT_TARGETS, LINT_VERSION, RULES, lint_repo
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m tools.sortlint")
+    ap.add_argument("targets", nargs="*", default=None,
+                    help=f"files/dirs to lint (default: {DEFAULT_TARGETS})")
+    ap.add_argument("--root", default=".", help="repo root")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        print(f"{LINT_VERSION}: {len(RULES)} rules")
+        for r in RULES:
+            print(f"  {r.id} [{r.scope}] {r.name}: {r.doc}")
+        return 0
+
+    findings = lint_repo(args.root, args.targets or DEFAULT_TARGETS)
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+        print(f.render())
+    n = len(findings)
+    print(f"sortlint: {n} finding(s), {len(RULES)} rules ({LINT_VERSION})",
+          file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,500 @@
+"""The sortlint rules (see package docstring for the one-line census).
+
+Everything here is pure ``ast`` + text: the linter never imports the
+package under lint, so it runs on a bare Python with no jax/numpy —
+the CI lint job's whole point.  The span schema is loaded from
+``mpitest_tpu/utils/span_schema.py`` by file path (that module is
+stdlib-only by design) so SL003 checks against the real registry, not
+a copy.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib.util
+import re
+from pathlib import Path
+from typing import Any, Iterator
+
+from tools.sortlint import Finding, Rule, register
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+
+def _load_span_schema() -> Any:
+    path = REPO_ROOT / "mpitest_tpu" / "utils" / "span_schema.py"
+    spec = importlib.util.spec_from_file_location("_sortlint_span_schema",
+                                                  path)
+    assert spec is not None and spec.loader is not None
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_SCHEMA = _load_span_schema()
+
+
+def _ends(path: str, *suffixes: str) -> bool:
+    p = path.replace("\\", "/")
+    return any(p.endswith(s) for s in suffixes)
+
+
+def _walk(node: ast.AST,
+          stack: tuple[str, ...] = ()) -> Iterator[tuple[ast.AST,
+                                                         tuple[str, ...]]]:
+    """ast.walk with the enclosing-function-name stack attached."""
+    yield node, stack
+    child_stack = stack
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        child_stack = stack + (node.name,)
+    for child in ast.iter_child_nodes(node):
+        yield from _walk(child, child_stack)
+
+
+def _attr_chain(node: ast.AST) -> str:
+    """Dotted name of an attribute/name chain ('' when not a chain)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+# ---------------------------------------------------------------- SL001
+
+def _check_env_read(path: str, src: str, tree: ast.AST) -> list[Finding]:
+    if _ends(path, "mpitest_tpu/utils/knobs.py"):
+        return []
+    out = []
+    for node, _ in _walk(tree):
+        chain = ""
+        if isinstance(node, ast.Call):
+            chain = _attr_chain(node.func)
+            if chain in ("os.environ.get", "os.getenv"):
+                out.append(Finding(
+                    "SL001", path, node.lineno,
+                    f"env read via {chain}; read knobs through "
+                    "mpitest_tpu.utils.knobs (get/get_raw) so the value "
+                    "is typed, validated and documented"))
+        elif isinstance(node, ast.Subscript) and \
+                isinstance(node.ctx, ast.Load) and \
+                _attr_chain(node.value) == "os.environ":
+            out.append(Finding(
+                "SL001", path, node.lineno,
+                "env read via os.environ[...]; use mpitest_tpu.utils."
+                "knobs instead (writes are fine, reads are not)"))
+        elif isinstance(node, ast.Compare) and \
+                any(isinstance(op, (ast.In, ast.NotIn))
+                    for op in node.ops) and \
+                any(_attr_chain(c) == "os.environ"
+                    for c in node.comparators):
+            out.append(Finding(
+                "SL001", path, node.lineno,
+                "membership test on os.environ; knobs.get_raw() is None "
+                "when unset"))
+    return out
+
+
+register(Rule(
+    "SL001", "env-knob-read",
+    "os.environ/os.getenv reads outside utils/knobs.py (writes allowed)",
+    _check_env_read))
+
+
+# --------------------------------------------------------- SL002 / SL003
+
+#: Modules that ARE the span mechanism — the rules police its users.
+_SPAN_EXEMPT = ("mpitest_tpu/utils/spans.py", "mpitest_tpu/utils/trace.py")
+
+
+def _span_call_kind(call: ast.Call) -> str | None:
+    """'span' for span-opening calls, 'point' for event/record/emit,
+    'phase' for Tracer.phase — None for anything else.
+
+    Matching is attribute-shaped on purpose: bare names like ``emit``
+    collide with unrelated local helpers, so only the idioms the repo
+    actually uses match — ``<x>.span`` / ``<x>.maybe_span`` (any base),
+    ``<x>.phase`` (Tracer), and ``event``/``record``/``emit`` when the
+    base is a span log (``spans`` / ``log`` / ``slog`` / ``span_log``).
+    """
+    f = call.func
+    if not isinstance(f, ast.Attribute):
+        return None
+    if f.attr in ("span", "maybe_span"):
+        return "span"
+    if f.attr == "phase":
+        return "phase"
+    if f.attr in ("event", "record", "emit"):
+        base = f.value
+        base_name = base.id if isinstance(base, ast.Name) else \
+            base.attr if isinstance(base, ast.Attribute) else ""
+        if base_name in ("spans", "log", "slog", "span_log"):
+            return "point"
+    return None
+
+
+def _check_span_ctx(path: str, src: str, tree: ast.AST) -> list[Finding]:
+    if _ends(path, *_SPAN_EXEMPT):
+        return []
+    allowed: set[int] = set()
+    for node, _ in _walk(tree):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                allowed.add(id(item.context_expr))
+        elif isinstance(node, ast.Return) and node.value is not None:
+            # wrapper idiom: `return spans.maybe_span(...)` — the caller
+            # enters it; the definition modules are exempt anyway
+            allowed.add(id(node.value))
+    out = []
+    for node, _ in _walk(tree):
+        if isinstance(node, ast.Call) \
+                and _span_call_kind(node) in ("span", "phase") \
+                and id(node) not in allowed:
+            out.append(Finding(
+                "SL002", path, node.lineno,
+                "span/phase opened outside a `with` statement (or "
+                "returned as one) — an un-entered span records nothing; "
+                "use `with ...span(...):` / `with ...phase(...):`"))
+    return out
+
+
+register(Rule(
+    "SL002", "span-context-manager",
+    "spans may only be opened as context managers",
+    _check_span_ctx))
+
+
+def _check_span_name(path: str, src: str, tree: ast.AST) -> list[Finding]:
+    if _ends(path, *_SPAN_EXEMPT):
+        return []
+    out = []
+    for node, _ in _walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        kind = _span_call_kind(node)
+        if kind is None or not node.args:
+            continue
+        first = node.args[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            name = first.value
+            ok = (name in _SCHEMA.PHASE_NAMES if kind == "phase"
+                  else _SCHEMA.is_registered(name))
+            if not ok:
+                where = ("utils/span_schema.py PHASE_NAMES"
+                         if kind == "phase" else "utils/span_schema.py")
+                out.append(Finding(
+                    "SL003", path, node.lineno,
+                    f"span name {name!r} is not registered in {where}; "
+                    "register it there (report.py aggregates by these "
+                    "names — unregistered spans vanish from the tables)"))
+        else:
+            out.append(Finding(
+                "SL003", path, node.lineno,
+                "non-literal span name — the registered-schema check "
+                "cannot see it; use a literal, or suppress with the "
+                "reason the name is provably schema-bound"))
+    return out
+
+
+register(Rule(
+    "SL003", "span-name-schema",
+    "literal span/phase names must come from utils/span_schema.py",
+    _check_span_name))
+
+
+# ------------------------------------------------------- SL010 / SL011 / SL012
+
+def _check_lax_reduce(path: str, src: str, tree: ast.AST) -> list[Finding]:
+    out = []
+    for node, _ in _walk(tree):
+        if isinstance(node, ast.Call):
+            chain = _attr_chain(node.func)
+            if chain.endswith("lax.reduce") or chain == "lax.reduce":
+                out.append(Finding(
+                    "SL010", path, node.lineno,
+                    "custom lax.reduce is UNIMPLEMENTED under the SPMD "
+                    "partitioner (PR 3 lesson); use a halving fold or a "
+                    "jnp reduction"))
+    return out
+
+
+register(Rule(
+    "SL010", "spmd-lax-reduce",
+    "lax.reduce is banned (SPMD partitioner cannot lower it)",
+    _check_lax_reduce))
+
+
+def _check_device_put(path: str, src: str, tree: ast.AST) -> list[Finding]:
+    out = []
+    for node, stack in _walk(tree):
+        if isinstance(node, ast.Call) and \
+                _attr_chain(node.func) == "jax.device_put" and \
+                "checked_device_put" not in stack:
+            out.append(Finding(
+                "SL011", path, node.lineno,
+                "bare jax.device_put silently downcasts when x64 is off "
+                "(PR 2 regression); use models.ingest.checked_device_put"))
+    return out
+
+
+register(Rule(
+    "SL011", "bare-device-put",
+    "jax.device_put only inside checked_device_put",
+    _check_device_put))
+
+_HOST_SYNC_CALLS = {
+    "np.asarray": "materializes the traced value on host",
+    "np.array": "materializes the traced value on host",
+    "numpy.asarray": "materializes the traced value on host",
+    "jax.device_get": "forces a device->host round-trip",
+    "jax.device_put": "host placement inside a traced region",
+}
+
+
+def _traced_function_names(tree: ast.AST) -> set[str]:
+    """Names of functions passed to jit()/shard_map() or decorated so."""
+    traced: set[str] = set()
+    for node, _ in _walk(tree):
+        if isinstance(node, ast.Call):
+            callee = _attr_chain(node.func)
+            if callee.split(".")[-1] in ("jit", "shard_map"):
+                for arg in node.args:
+                    if isinstance(arg, ast.Name):
+                        traced.add(arg.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                if _attr_chain(target).split(".")[-1] in ("jit",
+                                                          "shard_map"):
+                    traced.add(node.name)
+    return traced
+
+
+def _check_host_sync(path: str, src: str, tree: ast.AST) -> list[Finding]:
+    traced = _traced_function_names(tree)
+    if not traced:
+        return []
+    out = []
+    for node, stack in _walk(tree):
+        if not stack or not any(s in traced for s in stack):
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        if chain in _HOST_SYNC_CALLS:
+            out.append(Finding(
+                "SL012", path, node.lineno,
+                f"{chain} inside traced function "
+                f"{[s for s in stack if s in traced][-1]!r}: "
+                f"{_HOST_SYNC_CALLS[chain]}"))
+        elif isinstance(node.func, ast.Attribute) and \
+                node.func.attr in ("block_until_ready", "item"):
+            out.append(Finding(
+                "SL012", path, node.lineno,
+                f".{node.func.attr}() inside a traced function forces a "
+                "host sync / fails at trace time"))
+    return out
+
+
+register(Rule(
+    "SL012", "host-sync-in-traced",
+    "no host syncs inside jitted/shard_map'ed functions",
+    _check_host_sync))
+
+
+# ---------------------------------------------------------------- SL020
+
+def _parse_sites(faults_path: Path) -> list[str]:
+    tree = ast.parse(faults_path.read_text())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "SITES" and \
+                        isinstance(node.value, ast.Tuple):
+                    return [e.value for e in node.value.elts
+                            if isinstance(e, ast.Constant)]
+    return []
+
+
+def _check_fault_coverage(root: str, _src: str,
+                          _tree: ast.AST | None) -> list[Finding]:
+    rootp = Path(root)
+    out = []
+    faults_py = rootp / "mpitest_tpu" / "faults.py"
+    selftest = rootp / "bench" / "fault_selftest.py"
+    if faults_py.exists() and selftest.exists():
+        sites = _parse_sites(faults_py)
+        if not sites:
+            out.append(Finding("SL020", "mpitest_tpu/faults.py", 1,
+                               "could not parse the SITES tuple"))
+        body = selftest.read_text()
+        # The grid enumerates the registry itself (`for site in
+        # faults.SITES`) — that IS full coverage, and it stays complete
+        # when a new site is added.  Without that idiom, every site must
+        # appear literally.
+        if "faults.SITES" not in body:
+            for site in sites:
+                if site not in body:
+                    out.append(Finding(
+                        "SL020", "bench/fault_selftest.py", 1,
+                        f"fault site {site!r} (mpitest_tpu/faults.py "
+                        "SITES) is never exercised by the chaos grid"))
+    faults_h = rootp / "comm" / "comm_faults.h"
+    if faults_h.exists():
+        kinds = [m.group(1).lower() for m in
+                 re.finditer(r"COMM_FAULT_([A-Z]+)\s*=\s*\d",
+                             faults_h.read_text())
+                 if m.group(1) != "NONE"]
+        for backend in ("comm_local.c", "comm_mpi.c"):
+            src_c = (rootp / "comm" / backend).read_text()
+            if "comm_faults_enter" not in src_c:
+                out.append(Finding(
+                    "SL020", f"comm/{backend}", 1,
+                    "backend never calls comm_faults_enter — COMM_FAULTS "
+                    "drills are dead on this backend"))
+        if selftest.exists():
+            body = selftest.read_text()
+            for kind in kinds:
+                if f"{kind}:" not in body:
+                    out.append(Finding(
+                        "SL020", "bench/fault_selftest.py", 1,
+                        f"COMM_FAULTS kind {kind!r} (comm/comm_faults.h) "
+                        "is never drilled by the selftest"))
+    return out
+
+
+register(Rule(
+    "SL020", "fault-registry-coverage",
+    "every declared fault site is exercised; both C backends hook faults",
+    _check_fault_coverage, scope="repo"))
+
+
+# ------------------------------------------------------- SL030 / SL031
+
+def _registered_knobs(root: Path) -> list[tuple[str, int, str | None]]:
+    """(name, lineno, doc literal or None) per register() call."""
+    knobs_py = root / "mpitest_tpu" / "utils" / "knobs.py"
+    tree = ast.parse(knobs_py.read_text())
+    out = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "register"):
+            continue
+        name = (node.args[0].value
+                if node.args and isinstance(node.args[0], ast.Constant)
+                else None)
+        doc = None
+        if len(node.args) >= 5 and isinstance(node.args[4], ast.Constant):
+            doc = node.args[4].value
+        for kw in node.keywords:
+            if kw.arg == "doc" and isinstance(kw.value, ast.Constant):
+                doc = kw.value.value
+        out.append((name, node.lineno, doc))
+    return out
+
+
+def _check_knob_docs(root: str, _src: str,
+                     _tree: ast.AST | None) -> list[Finding]:
+    out = []
+    for name, lineno, doc in _registered_knobs(Path(root)):
+        where = "mpitest_tpu/utils/knobs.py"
+        if name is None:
+            out.append(Finding("SL030", where, lineno,
+                               "register() with a non-literal knob name — "
+                               "the registry must be statically auditable"))
+        elif not doc:
+            out.append(Finding("SL030", where, lineno,
+                               f"knob {name} registered without a literal "
+                               "nonempty doc"))
+    return out
+
+
+register(Rule(
+    "SL030", "knob-doc",
+    "every registered knob carries a literal nonempty doc",
+    _check_knob_docs, scope="repo"))
+
+
+def _check_knob_readme(root: str, _src: str,
+                       _tree: ast.AST | None) -> list[Finding]:
+    rootp = Path(root)
+    readme = rootp / "README.md"
+    if not readme.exists():
+        return [Finding("SL031", "README.md", 1, "README.md missing")]
+    body = readme.read_text()
+    out = []
+    for name, lineno, _doc in _registered_knobs(rootp):
+        if name and f"`{name}`" not in body:
+            out.append(Finding(
+                "SL031", "README.md", 1,
+                f"registered knob {name} is not documented in README "
+                "(run `make knob-docs` to regenerate the embedded table)"))
+    return out
+
+
+register(Rule(
+    "SL031", "knob-readme",
+    "every registered knob appears in README's reference table",
+    _check_knob_readme, scope="repo"))
+
+
+# ---------------------------------------------------------------- SL040
+
+#: The typed core: modules where every function signature must be fully
+#: annotated (the in-container proxy for the mypy strict gate).
+TYPED_MODULES = (
+    "mpitest_tpu/models/", "mpitest_tpu/parallel/",
+    "mpitest_tpu/utils/spans.py", "mpitest_tpu/utils/span_schema.py",
+    "mpitest_tpu/utils/knobs.py", "mpitest_tpu/faults.py",
+)
+
+
+def _in_typed_core(path: str) -> bool:
+    p = path.replace("\\", "/")
+    return any(("/" + t in p or p.startswith(t)) if t.endswith(".py")
+               else ("/" + t in p or p.startswith(t)) for t in TYPED_MODULES)
+
+
+def _check_typed_core(path: str, src: str, tree: ast.AST) -> list[Finding]:
+    if not _in_typed_core(path):
+        return []
+    out = []
+
+    def visit_scope(body: list[ast.stmt], in_class: bool) -> None:
+        for node in body:
+            if isinstance(node, ast.ClassDef):
+                visit_scope(node.body, in_class=True)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                a = node.args
+                args = (a.posonlyargs + a.args + a.kwonlyargs
+                        + ([a.vararg] if a.vararg else [])
+                        + ([a.kwarg] if a.kwarg else []))
+                skip_self = in_class and args and \
+                    args[0].arg in ("self", "cls")
+                missing = [arg.arg for arg in args[1 if skip_self else 0:]
+                           if arg.annotation is None]
+                if missing:
+                    out.append(Finding(
+                        "SL040", path, node.lineno,
+                        f"typed-core function {node.name!r} has "
+                        f"unannotated parameter(s): {', '.join(missing)}"))
+                if node.returns is None:
+                    out.append(Finding(
+                        "SL040", path, node.lineno,
+                        f"typed-core function {node.name!r} has no return "
+                        "annotation"))
+                # nested defs (jit bodies etc.) are exempt by design
+
+    if isinstance(tree, ast.Module):
+        visit_scope(tree.body, in_class=False)
+    return out
+
+
+register(Rule(
+    "SL040", "typed-core",
+    "full signature annotations in the typed core modules",
+    _check_typed_core))

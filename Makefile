@@ -22,11 +22,17 @@
 #                  silent-wrong-answer cells; warm verifier overhead is
 #                  asserted < 5% of sort wall.
 #   make ingest-selftest — end-to-end check of the streaming ingest
-#                  pipeline: a SORTBIN1 sort forced through the chunked
+#                  pipeline WITH the native encode engine forced on
+#                  (ISSUE 6): a SORTBIN1 sort forced through the chunked
 #                  pipeline under SORT_TRACE; `report.py --check
 #                  --require-ingest-overlap` then asserts the emitted
 #                  ingest.* spans show parse/encode genuinely
-#                  overlapping the host→device transfers
+#                  overlapping the host→device transfers AND the
+#                  recorded ingest_ratio meets the 0.5x end-to-end
+#                  gate; bench/ingest_selftest.py additionally asserts
+#                  native encode >= 2x the Python engine on this host
+#   make native-encode — build native/libencode.so (the C ingest
+#                  engine behind SORT_NATIVE_ENCODE, ISSUE 6)
 #   make lint    — static analysis (ISSUE 4): sortlint (the project's
 #                  custom AST rules — env-knob registry, span schema,
 #                  SPMD safety, fault coverage, typed core), the
@@ -50,9 +56,9 @@
 
 PYTHON ?= python3
 
-.PHONY: test native chip-test telemetry-selftest ingest-selftest \
-    fault-selftest lint cwarn-check typecheck tidy-check knob-docs \
-    sanitize-selftest clean
+.PHONY: test native native-encode chip-test telemetry-selftest \
+    ingest-selftest fault-selftest lint cwarn-check typecheck tidy-check \
+    knob-docs sanitize-selftest clean
 
 chip-test:
 	$(PYTHON) -u bench/chip_regression.py
@@ -65,6 +71,11 @@ native:
 	$(MAKE) -C mpi_radix_sort BACKEND=local
 	$(MAKE) -C bench BACKEND=local
 	$(MAKE) -C bench mpi-syntax-check
+
+# The native ingest engine alone (ISSUE 6): native/libencode.so for the
+# ctypes shim (utils/native_encode.py; SORT_NATIVE_ENCODE selects it).
+native-encode:
+	$(MAKE) -C bench libencode
 
 # One-command proof that both telemetry producers emit what the report
 # CLI can validate: TPU span stream (SORT_TRACE) on a virtual CPU mesh
@@ -97,13 +108,19 @@ fault-selftest:
 	    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 	    $(PYTHON) -u bench/fault_selftest.py
 
-# Proof the streamed ingest pipeline is live and actually overlapping:
-# a 2^22-key SORTBIN1 file (mmap-sliced into 16 chunks) sorted on a
-# virtual CPU mesh with the pipeline forced on; the span stream must
+# Proof the streamed ingest pipeline is live, overlapping, and fast
+# (ISSUE 6): the NATIVE encode engine is built and FORCED ON for every
+# leg.  Leg 1: a 2^22-key SORTBIN1 file (mmap-sliced into 16 chunks)
+# sorted through the CLI on a virtual CPU mesh; the span stream must
 # pass the schema check AND show nonzero parse/encode ∩ transfer
-# overlap — a serialized pipeline fails the gate.
+# overlap — a serialized pipeline fails the gate.  Leg 2:
+# bench/ingest_selftest.py asserts the perf contract — native encode
+# throughput >= 2x the Python engine's on this host, and
+# sort_incl_ingest_mkeys_per_s >= 0.5 x sort_mkeys_per_s — and records
+# both in a metrics sidecar; the final report pass re-checks the ratio
+# gate from that sidecar (--require-ingest-overlap reads ingest_ratio).
 INGEST_TMP := /tmp/mpitest_ingest_selftest
-ingest-selftest:
+ingest-selftest: native-encode
 	rm -rf $(INGEST_TMP) && mkdir -p $(INGEST_TMP)
 	$(PYTHON) -c "import numpy as np; \
 	    from mpitest_tpu.utils.io import write_keys_binary; \
@@ -112,12 +129,17 @@ ingest-selftest:
 	    dtype=np.int32))"
 	JAX_PLATFORMS=cpu \
 	    XLA_FLAGS="--xla_force_host_platform_device_count=4" \
-	    SORT_ALGO=radix SORT_RANKS=4 \
+	    SORT_ALGO=radix SORT_RANKS=4 SORT_NATIVE_ENCODE=on \
 	    SORT_INGEST=stream SORT_INGEST_CHUNK=262144 SORT_INGEST_THREADS=2 \
 	    SORT_TRACE=$(INGEST_TMP)/trace.jsonl \
 	    $(PYTHON) drivers/sort_cli.py $(INGEST_TMP)/keys.bin > /dev/null
+	JAX_PLATFORMS=cpu \
+	    XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+	    SORT_NATIVE_ENCODE=on \
+	    SORT_METRICS=$(INGEST_TMP)/metrics.jsonl \
+	    $(PYTHON) -u bench/ingest_selftest.py $(INGEST_TMP)/keys.bin
 	$(PYTHON) -m mpitest_tpu.report --check --require-ingest-overlap \
-	    $(INGEST_TMP)/trace.jsonl
+	    $(INGEST_TMP)/trace.jsonl $(INGEST_TMP)/metrics.jsonl
 
 # ---------------------------------------------------------------- lint
 # The static-analysis gate (ISSUE 4).  Always-on legs: sortlint, the
@@ -144,6 +166,8 @@ cwarn-check:
 	$(CC) $(CWARN) -Icomm native/comm_bench.c
 	$(CC) $(CWARN) -Icomm native/comm_fuzz.c
 	$(CC) $(CWARN) -Icomm/mpi_stub native/minimpi_earlyexit.c
+	$(CC) $(CWARN) -Inative native/encode.c
+	$(CC) $(CWARN) -Inative native/encode_fuzz.c
 	@echo "cwarn-check OK (-Wconversion -Wshadow -Werror clean)"
 
 typecheck:
@@ -202,6 +226,22 @@ sanitize-selftest:
 	    ASAN_OPTIONS="suppressions=$(SAN_SUPP)" COMM_RANKS=5 \
 	        ./bench/comm_fuzz $$s 200 > $(SAN_OUT)/asan_$$s || exit 1; \
 	    cat $(SAN_OUT)/asan_$$s; \
+	done
+	@echo "== ASan+UBSan: native encode kernel fuzz (ISSUE 6) =="
+	rm -f bench/encode_fuzz
+	$(MAKE) -C bench SANITIZE=address,undefined encode_fuzz
+	for s in $(SAN_SEEDS); do \
+	    ASAN_OPTIONS="suppressions=$(SAN_SUPP)" \
+	        ./bench/encode_fuzz $$s 300 > $(SAN_OUT)/encasan_$$s || exit 1; \
+	    cat $(SAN_OUT)/encasan_$$s; \
+	done
+	rm -f bench/encode_fuzz
+	$(MAKE) -C bench encode_fuzz
+	# sanitized-vs-plain differential: same seed must fold to the same
+	# checksum (UB the sanitizers altered would diverge here)
+	for s in $(SAN_SEEDS); do \
+	    ./bench/encode_fuzz $$s 300 > $(SAN_OUT)/encplain_$$s || exit 1; \
+	    cmp $(SAN_OUT)/encasan_$$s $(SAN_OUT)/encplain_$$s || exit 1; \
 	done
 	@echo "== ASan+UBSan: MPI backend over the fork-based minimpi runtime =="
 	rm -f bench/comm_selftest_minimpi bench/comm_fuzz_minimpi

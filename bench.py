@@ -263,7 +263,8 @@ def main() -> None:
     ing = staged.stats
     log(f"ingest (streamed host→mesh): {ingest_s:.3f}s = "
         f"{x.nbytes/ingest_s/1e9:.2f} GB/s (parse {ing.parse_s:.3f}s, "
-        f"encode {ing.encode_s:.3f}s, transfer {ing.transfer_s:.3f}s, "
+        f"encode {ing.encode_s:.3f}s [{ing.encode_engine}], "
+        f"transfer {ing.transfer_s:.3f}s, "
         f"overlap {ing.overlap_efficiency()*100:.0f}%, {ing.chunks} chunks)")
     del staged  # free the staged words before the steady-state loop
 
@@ -407,8 +408,20 @@ def main() -> None:
     metrics.record("ingest_overlap_efficiency",
                    round(ing.overlap_efficiency(), 4))
     metrics.record("ingest_chunks", ing.chunks)
+    # ISSUE 6: which engine encoded (auto may have degraded — the row
+    # must say so, not just the spans) and its measured throughput.
+    encode_engine = ing.encode_engine
+    encode_gbs = (round(ing.host_bytes / ing.encode_s / 1e9, 3)
+                  if ing.encode_s else None)
+    metrics.record("encode_engine", encode_engine)
+    if encode_gbs is not None:
+        metrics.record("encode_gb_per_s", encode_gbs, "GB/s")
+    ingest_ratio = None
     if incl_s is not None:
-        metrics.throughput("sort_incl_ingest_mkeys_per_s", n, incl_s)
+        incl_mkeys = metrics.throughput("sort_incl_ingest_mkeys_per_s",
+                                        n, incl_s)
+        ingest_ratio = round(incl_mkeys / mkeys, 4)
+        metrics.record("ingest_ratio", ingest_ratio, "x")
     # Robustness cost accounting (ISSUE 3): retries actually paid,
     # faults injected (nonzero only under SORT_FAULTS drills), and the
     # wall seconds the always-on verifier added to the LAST timed run —
@@ -444,8 +457,13 @@ def main() -> None:
         "retries": retries,
         "faults_injected": faults_injected,
         "verify_overhead_s": verify_s,
+        "encode_engine": encode_engine,
         "tooling": tooling_state(),
     }
+    if encode_gbs is not None:
+        out["encode_gb_per_s"] = encode_gbs
+    if ingest_ratio is not None:
+        out["ingest_ratio"] = ingest_ratio
     if vs_canonical is not None:
         out["vs_canonical_native"] = round(vs_canonical, 3)
     elif canon_skipped:

@@ -125,11 +125,17 @@ def main(argv: list[str] | None = None) -> int:
         oversample = knobs.get("SORT_OVERSAMPLE")
         knobs.validate(
             "SORT_INGEST", "SORT_INGEST_CHUNK", "SORT_INGEST_THREADS",
-            "SORT_DONATE", "SORT_VERIFY", "SORT_MAX_RETRIES",
-            "SORT_RETRY_BACKOFF", "SORT_FALLBACK", "SORT_FAULTS",
-            "SORT_FAULTS_SEED", "SORT_LOCAL_ENGINE",
+            "SORT_DONATE", "SORT_NATIVE_ENCODE", "SORT_VERIFY",
+            "SORT_MAX_RETRIES", "SORT_RETRY_BACKOFF", "SORT_FALLBACK",
+            "SORT_FAULTS", "SORT_FAULTS_SEED", "SORT_LOCAL_ENGINE",
         )
-    except ValueError as e:
+        # resolve the encode engine NOW: SORT_NATIVE_ENCODE=on with no
+        # usable libencode.so is one clean [ERROR] line here, never a
+        # RuntimeError traceback out of the first streamed chunk
+        from mpitest_tpu.utils import native_encode
+
+        native_encode.engine()
+    except (ValueError, RuntimeError) as e:
         knob_error(str(e))
         return 1
     try:
@@ -138,7 +144,9 @@ def main(argv: list[str] | None = None) -> int:
         # the file up front (text parses through the threaded chunk
         # reader).
         keys = kio.read_keys_auto(path, dtype=dtype, mmap=True)
-    except (OSError, ValueError):
+    except (OSError, ValueError, OverflowError):
+        # OverflowError: an out-of-range decimal token (both engines
+        # raise it — numpy's int cast and the native parser's ERANGE)
         print(f"sort(): '{path}' is not a valid file for read.", file=sys.stderr)
         return 1
     n = keys.size

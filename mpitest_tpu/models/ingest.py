@@ -10,11 +10,18 @@ serial staircase with a three-stage pipeline over fixed-size chunks:
 * **parse** (producer thread): materialize chunk k — an mmap page-in for
   SORTBIN1 slices, a slice view for in-memory arrays — and hand it to a
   bounded queue (depth 2: double buffering, not unbounded buffering).
-* **encode** (``SORT_INGEST_THREADS`` pool): ``codec.encode`` chunk k
-  into uint32 key words while chunk k-1 is still transferring; also
-  folds the chunk's per-word min/max (the radix pass planner's input)
-  and the running native max key (the padding value) into the stats, so
-  the sort needs NO extra host pass over the data afterwards.
+* **encode** (``SORT_INGEST_THREADS`` pool): encode chunk k into uint32
+  key words while chunk k-1 is still transferring; also folds the
+  chunk's per-word min/max (the radix pass planner's input), the
+  running native max key (the padding value) and the verifier
+  fingerprint, so the sort needs NO extra host pass over the data
+  afterwards.  The stage is engine-dispatched (ISSUE 6,
+  ``SORT_NATIVE_ENCODE``): the native C kernel
+  (:mod:`mpitest_tpu.utils.native_encode`) does all of that in ONE
+  GIL-released pass — for mmap'd SORTBIN1 it reads the pages in place,
+  so the host path is zero-copy (mmap → fold → staging words → DMA);
+  the Python engine is the original numpy multi-pass path, preserved
+  bit-for-bit as fallback and parity oracle.
 * **transfer** (one dedicated thread, in order): split the encoded chunk
   at shard boundaries (``parallel.mesh.shard_bounds``), ``device_put``
   each piece onto its owning device, and block until that chunk's DMA
@@ -63,10 +70,11 @@ import numpy as np
 
 from mpitest_tpu import faults
 from mpitest_tpu.models.supervisor import verify_enabled
-from mpitest_tpu.models.verify import Fingerprint, fingerprint_host
+from mpitest_tpu.models.verify import Fingerprint
 from mpitest_tpu.ops.keys import codec_for
 from mpitest_tpu.parallel.mesh import assemble_sharded, shard_bounds
 from mpitest_tpu.utils import io as kio
+from mpitest_tpu.utils import native_encode
 from mpitest_tpu.utils.spans import (SpanLog, merge_intervals,
                                      overlap_seconds)
 
@@ -128,6 +136,10 @@ class IngestStats:
     encode_s: float = 0.0
     transfer_s: float = 0.0
     wall_s: float = 0.0
+    #: encode engine the run actually used ("native" | "python") — the
+    #: observable half of the SORT_NATIVE_ENCODE=auto contract: a
+    #: degraded fallback shows up here, in spans, and in bench rows.
+    encode_engine: str = "python"
     host_iv: list = field(default_factory=list)  # (t0, t1) parse/encode
     xfer_iv: list = field(default_factory=list)  # (t0, t1) transfers
 
@@ -210,18 +222,13 @@ class _StreamState:
         self.fold_fp = fold_fp
         self.fp = Fingerprint.empty(n_words) if fold_fp else None
 
-    def fold_chunk(self, chunk: np.ndarray,
-                   words: tuple[np.ndarray, ...],
+    def apply_fold(self, los: list, his: list, m: object,
+                   chunk_fp: "Fingerprint | None",
                    t0: float, dt_s: float) -> None:
-        # full-chunk scans OUTSIDE the lock (they are the expensive
-        # part; holding the lock across them would serialize the encode
-        # pool) — only the scalar folds need mutual exclusion
-        los = [int(w.min()) for w in words]
-        his = [int(w.max()) for w in words]
-        m = chunk.max() if chunk.dtype.kind != "f" else None
-        # one digest definition (models/verify.py) — the scan runs
-        # outside the lock like the min/max folds above
-        chunk_fp = fingerprint_host(words) if self.fold_fp else None
+        """Merge one chunk's already-computed reductions (engine output,
+        utils/native_encode.encode_and_fold — the expensive scans ran
+        OUTSIDE the lock, on the encode worker) into the running state;
+        only these scalar folds need mutual exclusion."""
         with self.lock:
             self.stats.encode_s += dt_s
             self.stats.host_iv.append((t0, t0 + dt_s))
@@ -270,6 +277,9 @@ def stream_to_mesh(x: np.ndarray, mesh: "Mesh",
         raise ValueError("cannot stream an empty key array")
     chunk_elems = chunk_elems or kio.ingest_chunk_elems()
     threads = threads or kio.ingest_threads()
+    # engine resolved ONCE per run (SORT_NATIVE_ENCODE=on raises here,
+    # before any thread starts, if the library is missing)
+    eng = native_encode.engine()
     n_ranks = int(mesh.devices.size)
     n = max(1, math.ceil(N / n_ranks))
     total = n_ranks * n
@@ -277,19 +287,25 @@ def stream_to_mesh(x: np.ndarray, mesh: "Mesh",
     spans = _spans_of(tracer)
     state = _StreamState(codec.n_words, fold_fp=verify_enabled())
     state.stats.n = N
+    state.stats.encode_engine = eng
     # chunk k's pieces per device, appended in chunk order by the single
     # transfer thread: per_dev[d] = [piece0_words, piece1_words, ...]
     per_dev: list[list[tuple]] = [[] for _ in bounds]
-    # mmap-backed sources: the parse stage materializes the slice (the
-    # page-in IS the parse); plain arrays slice for free.  Walk the full
-    # base chain — asarray/reshape wrap the memmap in plain views.
+    # mmap-backed sources: with the PYTHON engine the parse stage
+    # materializes the slice (the page-in IS the parse); the NATIVE
+    # engine skips that copy entirely — the C kernel reads the mmap
+    # pages in-place during its single encode pass, so SORTBIN1 ingest
+    # is zero-copy on the host (mmap -> fold -> staging words, ISSUE 6).
+    # Walk the full base chain — asarray/reshape wrap the memmap in
+    # plain views.
     materialize = False
-    _b = x
-    while _b is not None:
-        if isinstance(_b, np.memmap):
-            materialize = True
-            break
-        _b = getattr(_b, "base", None)
+    if eng != "native":
+        _b = x
+        while _b is not None:
+            if isinstance(_b, np.memmap):
+                materialize = True
+                break
+            _b = getattr(_b, "base", None)
 
     abort = threading.Event()
 
@@ -333,17 +349,23 @@ def stream_to_mesh(x: np.ndarray, mesh: "Mesh",
             _put(q, e)
 
     def encode_one(k: int, chunk):
+        # engine-dispatched one-call encode stage: words + per-word
+        # min/max + pad key + fingerprint in one pass (native: a single
+        # GIL-released C sweep that also faults the mmap pages in).
+        # The timed interval covers the WHOLE stage for both engines,
+        # so encode_s / encode_gb_per_s compare like for like.
         t0 = time.perf_counter()
-        words = codec.encode(chunk)
+        words, los, his, m, chunk_fp = native_encode.encode_and_fold(
+            chunk, codec, state.fold_fp, eng)
         dt = time.perf_counter() - t0
-        state.fold_chunk(chunk, words, t0, dt)
+        state.apply_fold(los, his, m, chunk_fp, t0, dt)
         # fault injection (SORT_FAULTS=ingest_poison): corrupt AFTER the
         # fingerprint fold — the device receives bytes the fingerprint
         # never saw, which the output verifier must flag.
         words = faults.maybe_poison_chunk(words, k)
         if spans is not None:
             spans.record("ingest.encode", t0, dt, chunk=k,
-                         n=int(chunk.size),
+                         n=int(chunk.size), engine=eng,
                          bytes=int(sum(w.nbytes for w in words)))
         return words
 
@@ -462,6 +484,7 @@ def stream_to_mesh(x: np.ndarray, mesh: "Mesh",
     if spans is not None:
         spans.record("ingest.pipeline", t_wall, state.stats.wall_s,
                      n=N, chunks=state.stats.chunks,
+                     encode_engine=eng,
                      parse_s=round(state.stats.parse_s, 6),
                      encode_s=round(state.stats.encode_s, 6),
                      transfer_s=round(state.stats.transfer_s, 6),

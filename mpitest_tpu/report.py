@@ -49,6 +49,13 @@ from mpitest_tpu.utils.spans import (MPI_EQUIV, SCHEMA as SPAN_SCHEMA,
 
 COMM_STATS_SCHEMA = "comm_stats.v1"
 
+#: End-to-end ingest gate (ISSUE 6): sort_incl_ingest must hold at least
+#: this fraction of the raw sort throughput.  The ONE definition —
+#: bench/ingest_selftest.py asserts the same constant it records, and
+#: ``--require-ingest-overlap`` re-checks it from the recorded
+#: ``ingest_ratio`` metric when one is present.
+INGEST_RATIO_GATE = 0.5
+
 
 # --------------------------------------------------------------- loading
 
@@ -130,6 +137,8 @@ def aggregate(rows: list[dict]) -> dict:
     # versions; the report surfaces the last-seen state so a table of
     # numbers names the rule set that guarded them.
     tooling: dict | None = None
+    # encode engines seen on ingest.pipeline spans (ISSUE 6)
+    encode_engines: set = set()
     # overlap intervals grouped per (file, pid): t0 is a process-relative
     # perf_counter clock, so intervals from different runs appended to
     # one SORT_TRACE file live on unrelated timelines — comparing them
@@ -170,6 +179,13 @@ def aggregate(rows: list[dict]) -> dict:
                 robust["verify_runs"] += 1
                 if not obj.get("attrs", {}).get("ok", True):
                     robust["verify_failures"] += 1
+            elif name == "ingest.pipeline":
+                # umbrella span (excluded from stage sums): carries the
+                # run's chosen encode engine (ISSUE 6 — a degraded
+                # SORT_NATIVE_ENCODE=auto is visible here, never silent)
+                e = obj.get("attrs", {}).get("encode_engine")
+                if e:
+                    encode_engines.add(str(e))
             elif name in INGEST_HOST_STAGES or name in INGEST_XFER_STAGES:
                 row = ingest.setdefault(
                     name, {"seconds": 0.0, "count": 0, "bytes": 0})
@@ -220,6 +236,7 @@ def aggregate(rows: list[dict]) -> dict:
     return {"phases": phases, "collectives": colls, "metrics": metrics,
             "spans": span_counts, "ingest": ingest, "robustness": robust,
             "tooling": tooling,
+            "encode_engines": sorted(encode_engines),
             "ingest_overlap": direction_overlap("ingest"),
             "egress_overlap": direction_overlap("egress")}
 
@@ -358,6 +375,18 @@ def render(agg: dict) -> str:
                 out.append(
                     f"  {label} overlap: {ov['overlap_s']:.6f}s "
                     f"({ov['pct']:.1f}% of {ov['transfer_s']:.6f}s transfer)")
+        # ISSUE 6 telemetry: the engine that encoded, its measured
+        # throughput, and the end-to-end ratio (when recorded)
+        engines = agg.get("encode_engines") or []
+        if engines:
+            out.append(f"  encode engine: {', '.join(engines)}")
+        for mname, label in (("encode_gb_per_s", "encode throughput"),
+                             ("encode_speedup", "native-vs-python encode"),
+                             ("ingest_ratio", "incl-ingest / sort ratio")):
+            m = agg["metrics"].get(mname)
+            if m and m.get("value") is not None:
+                unit = m.get("unit") or ""
+                out.append(f"  {label}: {m['value']} {unit}".rstrip())
     rb = agg.get("robustness") or {}
     if any(rb.get(k) for k in ("faults", "retries", "verify_runs")):
         out.append("")
@@ -471,6 +500,18 @@ def main(argv: list[str] | None = None) -> int:
             return 1
         print(f"ingest overlap OK: {ov['overlap_s']:.6f}s "
               f"({ov['pct']:.1f}% of transfer)")
+        # ISSUE 6: when a run recorded its end-to-end ratio, re-check
+        # the 0.5x gate here — the selftest's artifacts must not say
+        # one thing while the gate says another.
+        m = agg["metrics"].get("ingest_ratio")
+        if m and m.get("value") is not None:
+            ratio = float(m["value"])
+            if ratio < INGEST_RATIO_GATE:
+                print(f"[ERROR] recorded ingest_ratio {ratio} < "
+                      f"{INGEST_RATIO_GATE} (sort_incl_ingest fell below "
+                      "half the raw sort throughput)", file=sys.stderr)
+                return 1
+            print(f"ingest ratio OK: {ratio} >= {INGEST_RATIO_GATE}")
     print(render(agg))
 
     if args.baseline:

@@ -24,7 +24,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from mpitest_tpu.utils import knobs
+from mpitest_tpu.utils import knobs, native_encode
 
 
 #: Binary key-file header (mirrored in native/sort_common.h): 8 bytes of
@@ -40,11 +40,10 @@ def _bin_header(dtype: np.dtype) -> bytes:
 
 
 def _check_bin_header(header: bytes, path: str, dtype: np.dtype) -> None:
-    kind, itemsize = chr(header[8]), header[9]
-    if (kind, itemsize) != (dtype.kind, dtype.itemsize):
-        raise ValueError(
-            f"'{path}' holds {kind}{itemsize * 8} keys, not {dtype.name}"
-        )
+    """Engine-dispatched (ISSUE 6): the native kernel and the Python
+    check raise byte-identical errors — utils/native_encode.py owns the
+    one message contract, the parity suite asserts it."""
+    native_encode.check_bin_header(header, path, dtype)
 
 
 def read_keys_text(path: str, dtype=np.int32) -> np.ndarray:
@@ -196,20 +195,16 @@ def open_keys_mmap(path: str, dtype=np.int32) -> np.ndarray:
     return np.memmap(path, dtype=dt, mode="r", offset=BIN_HEADER_LEN)
 
 
-def _parse_text_block(block: bytes, dt: np.dtype) -> np.ndarray:
+def _parse_text_block(block: bytes, dt: np.dtype,
+                      eng: str | None = None) -> np.ndarray:
     """One whitespace-delimited text block -> keys, same per-dtype
     semantics as :func:`read_keys_text` (uint64 exact, floats through a
-    float64 parse then narrowed, ints via an int64 intermediate), but
-    C-speed: numpy casts the byte-token array directly."""
-    tokens = block.split()
-    if not tokens:
-        return np.empty(0, dt)
-    toks = np.array(tokens)
-    if dt == np.dtype(np.uint64):
-        return toks.astype(np.uint64)
-    if dt.kind == "f":
-        return toks.astype(np.float64).astype(dt)
-    return toks.astype(np.int64).astype(dt)
+    float64 parse then narrowed, ints via an int64 intermediate).
+    Engine-dispatched (ISSUE 6): the native C decimal parser handles
+    integer dtypes when ``SORT_NATIVE_ENCODE`` selects it; float text
+    and ``off`` go through the numpy token cast.  Both paths raise the
+    same exception types on malformed tokens."""
+    return native_encode.parse_text_tokens(block, dt, eng=eng)
 
 
 #: Text-chunk byte budget per key: covers sign + 10 digits + newline for
@@ -250,11 +245,12 @@ def _iter_text_key_chunks(path: str, dt: np.dtype, chunk_elems: int,
     from concurrent.futures import ThreadPoolExecutor
 
     threads = threads or ingest_threads()
+    eng = native_encode.engine()  # resolved ONCE per file, not per block
     blocks = _iter_text_blocks(path, chunk_elems * _TEXT_BYTES_PER_KEY)
     with ThreadPoolExecutor(max_workers=threads) as ex:
         pending = deque()
         for b in blocks:
-            pending.append(ex.submit(_parse_text_block, b, dt))
+            pending.append(ex.submit(_parse_text_block, b, dt, eng))
             while len(pending) > threads:
                 yield pending.popleft().result()
         while pending:

@@ -338,6 +338,9 @@ register("SORT_DONATE", "enum", "auto", "auto | 1 | 0",
          "Donate staged word buffers to the SPMD program (auto: on TPU).",
          _enum("SORT_DONATE", ("auto", "1", "0"),
                err="{name}={raw!r}: use 'auto', '1' or '0'"))
+register("SORT_NATIVE_ENCODE", "enum", "auto", "auto | on | off",
+         "Native C encode/parse engine for ingest (utils/native_encode.py).",
+         _enum("SORT_NATIVE_ENCODE", ("auto", "on", "off")))
 
 # Robustness knobs (models/supervisor.py + faults.py).
 

@@ -1,0 +1,375 @@
+"""ctypes shim over the native ingest engine (native/encode.{h,c}).
+
+The engine replaces the ingest pipeline's four-to-five numpy passes per
+chunk (materialize, codec encode, per-word min, per-word max,
+fingerprint fold) with ONE C pass that reads each key once and folds
+every reduction in registers — and replaces numpy's str->int token
+conversion with a C decimal parser for text inputs.  ctypes releases
+the GIL around every call, so the ``SORT_INGEST_THREADS`` encode pool
+gets real parallelism instead of contended interpreter time.
+
+Engine selection is the registered knob ``SORT_NATIVE_ENCODE``:
+
+* ``auto`` (default) — native when ``native/libencode.so`` loads,
+  Python otherwise (the seed behavior);
+* ``on`` — native, and a missing/stale library is a LOUD RuntimeError
+  (`make native-encode` builds it) — forcing the engine must never
+  silently fall back;
+* ``off`` — the pure-Python path, bit-for-bit today's behavior.
+
+Parity contract (tests/test_native_encode.py): both engines produce
+bit-identical words, min/max, pad key and fingerprint on every chunk,
+and raise the SAME exception types on malformed input (ValueError for
+bad tokens/headers, OverflowError for out-of-range tokens).  The chosen
+engine is visible in spans (``encode_engine`` attr), ``IngestStats``
+and bench rows — a degraded ``auto`` is observable, never silent.
+
+Float TEXT parsing stays Python on both engines: C ``strtod`` and
+Python ``float()`` agree on conforming inputs, but the parity suite
+cannot bound the last-ulp behavior across libcs, and float text is not
+the hot format (SORTBIN1 is).  Float *encoding* (the totalOrder bit
+flip) is native — it is pure bit arithmetic with no rounding.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+import threading
+from pathlib import Path
+from typing import TYPE_CHECKING
+
+import numpy as np
+
+from mpitest_tpu.utils import knobs
+
+if TYPE_CHECKING:
+    from mpitest_tpu.models.verify import Fingerprint
+    from mpitest_tpu.ops.keys import KeyCodec
+
+_REPO = Path(__file__).resolve().parents[2]
+LIB_PATH = _REPO / "native" / "libencode.so"
+
+#: Must match ENC_ABI_VERSION in native/encode.h — a stale .so is
+#: refused at load, never called into.
+ABI_VERSION = 1
+
+# status codes (native/encode.h)
+_ENC_OK = 0
+_ENC_EDTYPE = -1
+_ENC_EBADTOK = -2
+_ENC_ERANGE = -3
+_ENC_EMAGIC = -4
+_ENC_EHDR = -5
+_ENC_ECAP = -6
+
+
+class _EncFold(ctypes.Structure):
+    _fields_ = [
+        ("count", ctypes.c_uint64),
+        ("xor0", ctypes.c_uint32), ("xor1", ctypes.c_uint32),
+        ("sum0", ctypes.c_uint32), ("sum1", ctypes.c_uint32),
+        ("min0", ctypes.c_uint32), ("min1", ctypes.c_uint32),
+        ("max0", ctypes.c_uint32), ("max1", ctypes.c_uint32),
+        ("lexmax0", ctypes.c_uint32), ("lexmax1", ctypes.c_uint32),
+    ]
+
+
+_LOADED = False
+_LIB: ctypes.CDLL | None = None
+_LIB_ERR: str | None = None
+#: guards the one-time load: concurrent first resolutions (two ingest
+#: runs, or io's text reader racing stream_to_mesh) must both see the
+#: COMPLETED verdict, never a half-written (_LOADED, _LIB) pair.
+_LOAD_LOCK = threading.Lock()
+
+
+def _bind(lib: ctypes.CDLL) -> None:
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    lib.enc_abi_version.restype = ctypes.c_int
+    lib.enc_abi_version.argtypes = []
+    lib.enc_encode_fold.restype = ctypes.c_int
+    lib.enc_encode_fold.argtypes = [
+        ctypes.c_void_p, ctypes.c_size_t, ctypes.c_char, ctypes.c_int,
+        u32p, u32p, ctypes.c_int, ctypes.POINTER(_EncFold)]
+    lib.enc_count_tokens.restype = ctypes.c_longlong
+    lib.enc_count_tokens.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+    lib.enc_parse_i64.restype = ctypes.c_longlong
+    lib.enc_parse_i64.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_size_t, ctypes.POINTER(ctypes.c_size_t)]
+    lib.enc_parse_u64.restype = ctypes.c_longlong
+    lib.enc_parse_u64.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_size_t, ctypes.POINTER(ctypes.c_size_t)]
+    lib.enc_check_header.restype = ctypes.c_int
+    lib.enc_check_header.argtypes = [
+        u8p, ctypes.c_size_t, ctypes.c_char, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_char), ctypes.POINTER(ctypes.c_int)]
+
+
+def _load() -> ctypes.CDLL | None:
+    """Load (once) and ABI-check the engine library; None + a recorded
+    reason on any failure — ``auto`` degrades to Python, ``on`` raises."""
+    global _LOADED, _LIB, _LIB_ERR
+    if _LOADED:
+        return _LIB
+    with _LOAD_LOCK:
+        if _LOADED:  # another thread completed the load while we waited
+            return _LIB
+        lib: ctypes.CDLL | None = None
+        err: str | None = None
+        if not LIB_PATH.exists():
+            err = f"{LIB_PATH} not built (run `make native-encode`)"
+        else:
+            try:
+                lib = ctypes.CDLL(str(LIB_PATH))
+                _bind(lib)
+                got = int(lib.enc_abi_version())
+                if got != ABI_VERSION:
+                    err = (f"{LIB_PATH} has ABI v{got}, shim expects "
+                           f"v{ABI_VERSION} (rebuild: `make native-encode`)")
+                    lib = None
+            except (OSError, AttributeError) as e:
+                # AttributeError: a stale .so missing a symbol dies
+                # inside _bind() before the ABI stamp can be read —
+                # same verdict (unusable library), same loud-or-degrade
+                # handling.
+                err = (f"{LIB_PATH} failed to load: {e} "
+                       "(rebuild: `make native-encode`)")
+                lib = None
+        _LIB, _LIB_ERR = lib, err
+        _LOADED = True  # published LAST: readers never see a half-load
+    return _LIB
+
+
+def available() -> bool:
+    """True iff the native library is present, loadable and ABI-matched."""
+    return _load() is not None
+
+
+def unavailable_reason() -> str | None:
+    _load()
+    return _LIB_ERR
+
+
+def engine() -> str:
+    """Resolve ``SORT_NATIVE_ENCODE`` to the engine for this run:
+    ``"native"`` or ``"python"``.  ``on`` with no usable library raises
+    (forcing the engine must never silently degrade)."""
+    mode = knobs.get("SORT_NATIVE_ENCODE")
+    if mode == "off":
+        return "python"
+    if available():
+        return "native"
+    if mode == "on":
+        raise RuntimeError(
+            f"SORT_NATIVE_ENCODE=on but the native engine is unavailable: "
+            f"{_LIB_ERR}")
+    return "python"
+
+
+def build(quiet: bool = True) -> bool:
+    """Best-effort build of the engine library (`make -C bench libencode`)
+    — the test suite's fixture hook; selftests go through the Makefile."""
+    global _LOADED, _LIB, _LIB_ERR
+    r = subprocess.run(
+        ["make", "-C", str(_REPO / "bench"), "libencode"],
+        capture_output=quiet, text=True)
+    with _LOAD_LOCK:  # a racing _load() must not republish a stale handle
+        _LOADED, _LIB, _LIB_ERR = False, None, None  # force a re-probe
+    return r.returncode == 0 and available()
+
+
+# ------------------------------------------------------------ encode path
+
+def encode_and_fold(
+    chunk: np.ndarray,
+    codec: "KeyCodec",
+    fold_fp: bool,
+    eng: str | None = None,
+) -> "tuple[tuple[np.ndarray, ...], list[int], list[int], object, Fingerprint | None]":
+    """One chunk's full encode-stage work, engine-dispatched: returns
+    ``(words, word_mins, word_maxs, native_max, fingerprint)`` where
+    ``words`` are the codec's planar uint32 arrays (msw first),
+    ``word_mins``/``word_maxs`` are per-word reductions over the encoded
+    words, ``native_max`` is the chunk's maximum key in native dtype
+    (None for float dtypes — they pad with the totalOrder sentinel), and
+    ``fingerprint`` is the models/verify.py chunk digest (None when
+    ``fold_fp`` is False).  Both engines return bit-identical values.
+
+    Chunks must be non-empty: the pipeline never produces one, and an
+    empty chunk has no well-defined min/max/pad — rejected identically
+    for both engines rather than letting the Python path crash in
+    ``w.min()`` while the native path returns inverted neutral folds.
+    """
+    if np.asarray(chunk).size == 0:
+        raise ValueError("encode_and_fold: empty chunk (no min/max/pad "
+                         "is defined; the pipeline never produces one)")
+    if eng is None:
+        eng = engine()
+    if eng == "native":
+        return _encode_fold_native(chunk, codec, fold_fp)
+    return _encode_fold_python(chunk, codec, fold_fp)
+
+
+def _encode_fold_python(
+    chunk: np.ndarray, codec: "KeyCodec", fold_fp: bool,
+) -> "tuple[tuple[np.ndarray, ...], list[int], list[int], object, Fingerprint | None]":
+    """The pure-Python encode stage — exactly the pre-engine pipeline
+    behavior (codec encode + per-word min/max passes + host fingerprint
+    fold + native max), kept as the ``off`` path and the parity oracle."""
+    from mpitest_tpu.models.verify import fingerprint_host
+
+    words = codec.encode(chunk)
+    los = [int(w.min()) for w in words]
+    his = [int(w.max()) for w in words]
+    m = chunk.max() if chunk.dtype.kind != "f" else None
+    fp = fingerprint_host(words) if fold_fp else None
+    return words, los, his, m, fp
+
+
+def _encode_fold_native(
+    chunk: np.ndarray, codec: "KeyCodec", fold_fp: bool,
+) -> "tuple[tuple[np.ndarray, ...], list[int], list[int], object, Fingerprint | None]":
+    from mpitest_tpu.models.verify import Fingerprint
+
+    lib = _load()
+    assert lib is not None, "engine() guards this path"
+    dt = codec.dtype
+    if (not chunk.flags.c_contiguous or not chunk.flags.aligned
+            or chunk.dtype != dt):
+        # strided views cannot hand C a flat pointer, and a misaligned
+        # buffer (np.frombuffer at an odd offset) would make the kernel
+        # do unaligned uint32/uint64 loads — UB; normalize first
+        chunk = np.ascontiguousarray(chunk, dtype=dt)
+    n = int(chunk.size)
+    words = tuple(np.empty(n, np.uint32) for _ in range(codec.n_words))
+    w0 = words[0].ctypes.data_as(ctypes.POINTER(ctypes.c_uint32))
+    w1 = (words[1].ctypes.data_as(ctypes.POINTER(ctypes.c_uint32))
+          if codec.n_words == 2 else None)
+    fold = _EncFold()
+    rc = lib.enc_encode_fold(
+        chunk.ctypes.data_as(ctypes.c_void_p), n,
+        dt.kind.encode(), int(dt.itemsize), w0, w1,
+        1 if fold_fp else 0, ctypes.byref(fold))
+    if rc != _ENC_OK:
+        raise TypeError(f"unsupported key dtype: {dt}")
+    if codec.n_words == 1:
+        los, his = [int(fold.min0)], [int(fold.max0)]
+        lexmax = (int(fold.lexmax0),)
+        fp = (Fingerprint(n, (int(fold.xor0),), (int(fold.sum0),))
+              if fold_fp else None)
+    else:
+        los = [int(fold.min0), int(fold.min1)]
+        his = [int(fold.max0), int(fold.max1)]
+        lexmax = (int(fold.lexmax0), int(fold.lexmax1))
+        fp = (Fingerprint(n, (int(fold.xor0), int(fold.xor1)),
+                          (int(fold.sum0), int(fold.sum1)))
+              if fold_fp else None)
+    if dt.kind == "f":
+        m = None
+    else:
+        # the lex max of the encoded words IS encode(max key) (the codec
+        # is order-preserving); decode the 1-element pad key back to the
+        # native scalar the pipeline's pad logic expects
+        m = codec.decode(tuple(np.full(1, v, np.uint32)
+                               for v in lexmax))[0]
+    return words, los, his, m, fp
+
+
+# ------------------------------------------------------------- text parse
+
+def parse_text_tokens(block: bytes, dt: np.dtype,
+                      eng: str | None = None) -> np.ndarray:
+    """Whitespace-separated decimal tokens -> keys of ``dt``, matching
+    ``utils.io._parse_text_block`` semantics exactly: int dtypes go
+    through an int64 intermediate then truncate; uint64 parses exact;
+    float dtypes ALWAYS use the Python parser (see module docstring).
+    Malformed tokens raise ValueError, out-of-container tokens raise
+    OverflowError — the same types numpy's str casts raise."""
+    if eng is None:
+        eng = engine()
+    if eng != "native" or dt.kind == "f":
+        return _parse_text_python(block, dt)
+    lib = _load()
+    assert lib is not None
+    n_toks = int(lib.enc_count_tokens(block, len(block)))
+    if n_toks == 0:
+        return np.empty(0, dt)
+    bad = ctypes.c_size_t()
+    if dt == np.dtype(np.uint64):
+        out = np.empty(n_toks, np.uint64)
+        rc = int(lib.enc_parse_u64(
+            block, len(block),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            n_toks, ctypes.byref(bad)))
+    else:
+        out = np.empty(n_toks, np.int64)
+        rc = int(lib.enc_parse_i64(
+            block, len(block),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            n_toks, ctypes.byref(bad)))
+    if rc < 0:
+        tok = block[bad.value:bad.value + 32].split()[0]
+        if rc == _ENC_ERANGE:
+            raise OverflowError(
+                f"token {tok.decode(errors='replace')!r} out of range "
+                f"for the {('uint64' if dt == np.dtype(np.uint64) else 'int64')} "
+                "container")
+        raise ValueError(
+            "invalid literal for int() with base 10: "
+            f"{tok.decode(errors='replace')!r}")
+    assert rc == n_toks, "token count and parse disagree (engine bug)"
+    return out if out.dtype == dt else out.astype(dt)
+
+
+def _parse_text_python(block: bytes, dt: np.dtype) -> np.ndarray:
+    """The numpy token parse — today's ``io._parse_text_block`` body."""
+    tokens = block.split()
+    if not tokens:
+        return np.empty(0, dt)
+    toks = np.array(tokens)
+    if dt == np.dtype(np.uint64):
+        return toks.astype(np.uint64)
+    if dt.kind == "f":
+        return toks.astype(np.float64).astype(dt)
+    return toks.astype(np.int64).astype(dt)
+
+
+# ----------------------------------------------------------------- header
+
+def check_bin_header(header: bytes, path: str, dtype: np.dtype,
+                     eng: str | None = None) -> None:
+    """SORTBIN1 header validation, engine-dispatched, raising io.py's
+    exact error messages from either engine (the parity suite asserts
+    message equality, not just type equality, for headers)."""
+    if eng is None:
+        eng = engine()
+    if eng == "native":
+        lib = _load()
+        assert lib is not None
+        got_kind = ctypes.c_char()
+        got_size = ctypes.c_int()
+        buf = (ctypes.c_uint8 * len(header)).from_buffer_copy(header)
+        rc = int(lib.enc_check_header(
+            buf, len(header), dtype.kind.encode(), int(dtype.itemsize),
+            ctypes.byref(got_kind), ctypes.byref(got_size)))
+        if rc == _ENC_EMAGIC:
+            raise ValueError(f"'{path}' is not a SORTBIN1 key file")
+        if rc == _ENC_EHDR:
+            # latin-1: any byte value decodes to the same char chr()
+            # gives the Python engine — a garbage 0xFF kind byte must
+            # reproduce io.py's message, not a UnicodeDecodeError
+            kind = got_kind.value.decode("latin-1")
+            raise ValueError(
+                f"'{path}' holds {kind}{got_size.value * 8} keys, "
+                f"not {dtype.name}")
+        return
+    if header[:8] != b"SORTBIN1" or len(header) < 16:
+        raise ValueError(f"'{path}' is not a SORTBIN1 key file")
+    kind, itemsize = chr(header[8]), header[9]
+    if (kind, itemsize) != (dtype.kind, dtype.itemsize):
+        raise ValueError(
+            f"'{path}' holds {kind}{itemsize * 8} keys, not {dtype.name}")

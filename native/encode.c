@@ -1,0 +1,291 @@
+/* encode.c — the native ingest engine behind mpitest_tpu/utils/native_encode.py.
+ *
+ * One pass per chunk: each key is read once, its order-preserving
+ * uint32-word encoding (mpitest_tpu/ops/keys.py codec, msw first) is
+ * written to the planar out arrays, and min/max/XOR/wrapping-sum/count
+ * plus the lexicographic-maximum key fold through registers on the way.
+ * Float encodes read the IEEE bit pattern straight off the buffer (the
+ * totalOrder flip is pure bit arithmetic), so no FP instruction runs at
+ * all.  Built as libencode.so by bench/Makefile (`make native-encode`);
+ * -Wconversion -Wshadow -Werror clean (root cwarn-check), ASan/UBSan
+ * fuzzed via native/encode_fuzz.c.
+ */
+#include "encode.h"
+
+int enc_abi_version(void) { return ENC_ABI_VERSION; }
+
+/* ------------------------------------------------------------- encode */
+
+#define SIGN32 0x80000000u
+#define SIGN64 0x8000000000000000ULL
+
+static void fold_init(enc_fold *f) {
+    f->count = 0;
+    f->xor0 = f->xor1 = 0;
+    f->sum0 = f->sum1 = 0;
+    f->min0 = f->min1 = 0xFFFFFFFFu;
+    f->max0 = f->max1 = 0;
+    f->lexmax0 = f->lexmax1 = 0;
+}
+
+/* 1-word fold step, kept branch-light so gcc vectorizes the loops.
+ * `fp` is a compile-time constant at every call site (the dispatcher
+ * below passes literals), so the fingerprint branch folds away. */
+#define FOLD1(e)                                                        \
+    do {                                                                \
+        uint32_t e_ = (e);                                              \
+        w0[i] = e_;                                                     \
+        if (e_ < mn0) mn0 = e_;                                         \
+        if (e_ > mx0) mx0 = e_;                                         \
+        if (fp) { xr0 ^= e_; sm0 += e_; }                               \
+    } while (0)
+
+#define FOLD2(u)                                                        \
+    do {                                                                \
+        uint64_t u_ = (u);                                              \
+        uint32_t hi_ = (uint32_t)(u_ >> 32);                            \
+        uint32_t lo_ = (uint32_t)(u_ & 0xFFFFFFFFu);                    \
+        w0[i] = hi_;                                                    \
+        w1[i] = lo_;                                                    \
+        if (hi_ < mn0) mn0 = hi_;                                       \
+        if (hi_ > mx0) mx0 = hi_;                                       \
+        if (lo_ < mn1) mn1 = lo_;                                       \
+        if (lo_ > mx1) mx1 = lo_;                                       \
+        if (u_ > lex) lex = u_;                                         \
+        if (fp) { xr0 ^= hi_; sm0 += hi_; xr1 ^= lo_; sm1 += lo_; }     \
+    } while (0)
+
+static int encode_fold_impl(const void *src, size_t n, char kind,
+                            int itemsize, uint32_t *w0, uint32_t *w1,
+                            const int fp, enc_fold *fold) {
+    uint32_t mn0 = 0xFFFFFFFFu, mx0 = 0, xr0 = 0, sm0 = 0;
+    uint32_t mn1 = 0xFFFFFFFFu, mx1 = 0, xr1 = 0, sm1 = 0;
+    uint64_t lex = 0;
+    int two_words = 0;
+
+    if (kind == 'i' && itemsize == 1) {
+        const int8_t *p = (const int8_t *)src;
+        for (size_t i = 0; i < n; i++)
+            FOLD1((uint32_t)(int32_t)p[i] ^ SIGN32);
+    } else if (kind == 'i' && itemsize == 2) {
+        const int16_t *p = (const int16_t *)src;
+        for (size_t i = 0; i < n; i++)
+            FOLD1((uint32_t)(int32_t)p[i] ^ SIGN32);
+    } else if (kind == 'i' && itemsize == 4) {
+        const uint32_t *p = (const uint32_t *)src;  /* int32 bits */
+        for (size_t i = 0; i < n; i++)
+            FOLD1(p[i] ^ SIGN32);
+    } else if (kind == 'u' && itemsize == 1) {
+        const uint8_t *p = (const uint8_t *)src;
+        for (size_t i = 0; i < n; i++)
+            FOLD1((uint32_t)p[i]);
+    } else if (kind == 'u' && itemsize == 2) {
+        const uint16_t *p = (const uint16_t *)src;
+        for (size_t i = 0; i < n; i++)
+            FOLD1((uint32_t)p[i]);
+    } else if (kind == 'u' && itemsize == 4) {
+        const uint32_t *p = (const uint32_t *)src;
+        for (size_t i = 0; i < n; i++)
+            FOLD1(p[i]);
+    } else if (kind == 'f' && itemsize == 4) {
+        const uint32_t *p = (const uint32_t *)src;  /* IEEE bits */
+        for (size_t i = 0; i < n; i++) {
+            uint32_t u = p[i];
+            FOLD1((u & SIGN32) ? ~u : (u ^ SIGN32));
+        }
+    } else if (kind == 'i' && itemsize == 8) {
+        const uint64_t *p = (const uint64_t *)src;  /* int64 bits */
+        two_words = 1;
+        for (size_t i = 0; i < n; i++)
+            FOLD2(p[i] ^ SIGN64);
+    } else if (kind == 'u' && itemsize == 8) {
+        const uint64_t *p = (const uint64_t *)src;
+        two_words = 1;
+        for (size_t i = 0; i < n; i++)
+            FOLD2(p[i]);
+    } else if (kind == 'f' && itemsize == 8) {
+        const uint64_t *p = (const uint64_t *)src;  /* IEEE bits */
+        two_words = 1;
+        for (size_t i = 0; i < n; i++) {
+            uint64_t u = p[i];
+            FOLD2((u & SIGN64) ? ~u : (u ^ SIGN64));
+        }
+    } else {
+        return ENC_EDTYPE;
+    }
+
+    fold->count = (uint64_t)n;
+    fold->xor0 = xr0; fold->xor1 = xr1;
+    fold->sum0 = sm0; fold->sum1 = sm1;
+    fold->min0 = mn0; fold->min1 = mn1;
+    fold->max0 = mx0; fold->max1 = mx1;
+    if (two_words) {
+        fold->lexmax0 = (uint32_t)(lex >> 32);
+        fold->lexmax1 = (uint32_t)(lex & 0xFFFFFFFFu);
+    } else {
+        fold->lexmax0 = mx0;
+        fold->lexmax1 = 0;
+    }
+    return ENC_OK;
+}
+
+int enc_encode_fold(const void *src, size_t n, char kind, int itemsize,
+                    uint32_t *w0, uint32_t *w1, int fold_fp,
+                    enc_fold *fold) {
+    fold_init(fold);
+    if (n == 0) {
+        /* neutral fold; still reject an unsupported dtype loudly */
+        if (!((kind == 'i' || kind == 'u') &&
+              (itemsize == 1 || itemsize == 2 || itemsize == 4 ||
+               itemsize == 8)) &&
+            !(kind == 'f' && (itemsize == 4 || itemsize == 8)))
+            return ENC_EDTYPE;
+        return ENC_OK;
+    }
+    /* constant-propagated specializations: the fingerprint branch is
+     * dead code in the fp=0 instantiation (SORT_VERIFY=0 pays nothing) */
+    return fold_fp
+        ? encode_fold_impl(src, n, kind, itemsize, w0, w1, 1, fold)
+        : encode_fold_impl(src, n, kind, itemsize, w0, w1, 0, fold);
+}
+
+/* -------------------------------------------------------------- parse */
+
+/* ASCII whitespace, the Python bytes.split() set. */
+static int is_ws(char c) {
+    return c == ' ' || c == '\t' || c == '\n' || c == '\v' ||
+           c == '\f' || c == '\r';
+}
+
+long long enc_count_tokens(const char *buf, size_t len) {
+    long long n = 0;
+    size_t i = 0;
+    while (i < len) {
+        while (i < len && is_ws(buf[i])) i++;
+        if (i >= len) break;
+        n++;
+        while (i < len && !is_ws(buf[i])) i++;
+    }
+    return n;
+}
+
+/* Shared token scanner: parses one [+-]?digits token at buf[i..), with
+ * magnitude accumulated in uint64 (overflow-guarded).  Underscores are
+ * accepted strictly BETWEEN digits (PEP 515), because the Python
+ * engine's token cast routes through int() which accepts "1_000" — the
+ * parity contract is int()'s grammar, not fscanf's.  Returns ENC_OK
+ * and advances *ip past the token, or a negative status. */
+static int parse_tok(const char *buf, size_t len, size_t *ip,
+                     uint64_t *mag_out, int *neg_out) {
+    size_t i = *ip;
+    int neg = 0;
+    if (buf[i] == '+' || buf[i] == '-') {
+        neg = buf[i] == '-';
+        i++;
+    }
+    if (i >= len || buf[i] < '0' || buf[i] > '9')
+        return ENC_EBADTOK;  /* empty digits: bare sign, or non-digit */
+    uint64_t mag = 0;
+    int prev_digit = 0;
+    int over = 0;  /* overflow reported only for a WELL-FORMED token:
+                    * int() rejects "9...9x" as a bad literal before any
+                    * range question arises, so garbage must win */
+    while (i < len && !is_ws(buf[i])) {
+        char c = buf[i];
+        if (c == '_') {
+            /* legal only between digits: previous char a digit AND the
+             * next char a digit (int() rejects "1_", "1__2", "_1") */
+            if (!prev_digit || i + 1 >= len ||
+                buf[i + 1] < '0' || buf[i + 1] > '9')
+                return ENC_EBADTOK;
+            prev_digit = 0;
+            i++;
+            continue;
+        }
+        if (c < '0' || c > '9')
+            return ENC_EBADTOK;
+        uint64_t d = (uint64_t)(c - '0');
+        if (over || mag > (0xFFFFFFFFFFFFFFFFULL - d) / 10u)
+            over = 1;  /* keep scanning: a later bad char outranks this */
+        else
+            mag = mag * 10u + d;
+        prev_digit = 1;
+        i++;
+    }
+    if (over)
+        return ENC_ERANGE;
+    *ip = i;
+    *mag_out = mag;
+    *neg_out = neg;
+    return ENC_OK;
+}
+
+long long enc_parse_i64(const char *buf, size_t len, int64_t *out,
+                        size_t cap, size_t *bad_off) {
+    size_t i = 0, n = 0;
+    while (i < len) {
+        while (i < len && is_ws(buf[i])) i++;
+        if (i >= len) break;
+        size_t tok_start = i;
+        uint64_t mag;
+        int neg;
+        int rc = parse_tok(buf, len, &i, &mag, &neg);
+        if (rc == ENC_OK) {
+            uint64_t limit = neg ? SIGN64 : SIGN64 - 1u;
+            if (mag > limit) rc = ENC_ERANGE;
+        }
+        if (rc != ENC_OK) {
+            *bad_off = tok_start;
+            return rc;
+        }
+        if (n >= cap) {
+            *bad_off = tok_start;
+            return ENC_ECAP;
+        }
+        out[n++] = neg ? (int64_t)(0u - mag) : (int64_t)mag;
+    }
+    return (long long)n;
+}
+
+long long enc_parse_u64(const char *buf, size_t len, uint64_t *out,
+                        size_t cap, size_t *bad_off) {
+    size_t i = 0, n = 0;
+    while (i < len) {
+        while (i < len && is_ws(buf[i])) i++;
+        if (i >= len) break;
+        size_t tok_start = i;
+        uint64_t mag;
+        int neg;
+        int rc = parse_tok(buf, len, &i, &mag, &neg);
+        if (rc == ENC_OK && neg && mag > 0)
+            rc = ENC_ERANGE;  /* int(tok) < 0: out of uint64 bounds */
+        if (rc != ENC_OK) {
+            *bad_off = tok_start;
+            return rc;
+        }
+        if (n >= cap) {
+            *bad_off = tok_start;
+            return ENC_ECAP;
+        }
+        out[n++] = mag;
+    }
+    return (long long)n;
+}
+
+/* ------------------------------------------------------------- header */
+
+int enc_check_header(const unsigned char *hdr, size_t len, char kind,
+                     int itemsize, char *got_kind, int *got_size) {
+    static const unsigned char magic[8] = {'S', 'O', 'R', 'T',
+                                           'B', 'I', 'N', '1'};
+    if (len < 16)
+        return ENC_EMAGIC;
+    for (int i = 0; i < 8; i++)
+        if (hdr[i] != magic[i])
+            return ENC_EMAGIC;
+    *got_kind = (char)hdr[8];
+    *got_size = (int)hdr[9];
+    if ((char)hdr[8] != kind || (int)hdr[9] != itemsize)
+        return ENC_EHDR;
+    return ENC_OK;
+}

@@ -1,0 +1,100 @@
+/* encode.h — native ingest engine: one-pass parse/encode/reduce kernels.
+ *
+ * The Python ingest pipeline (mpitest_tpu/models/ingest.py) used to pay
+ * four to five separate numpy passes per chunk — materialize the mmap
+ * slice, codec-encode it into uint32 words, per-word min(), per-word
+ * max(), then the XOR/sum fingerprint fold — which pinned text/SORTBIN1
+ * ingest at ~1.2-1.4 GB/s while the device sort idled (ISSUE 6).  The
+ * kernels here do the whole per-chunk job in ONE pass over the buffer:
+ * read each key once, write its order-preserving uint32 word encoding
+ * (the exact codec of mpitest_tpu/ops/keys.py, msw first), and fold
+ * min/max/XOR/wrapping-sum/count and the lexicographic max key as the
+ * values stream through registers.  gcc -O3 autovectorizes the 4-byte
+ * paths; the loops carry no branches beyond the dtype dispatch.
+ *
+ * Exposed to Python via ctypes (mpitest_tpu/utils/native_encode.py,
+ * knob SORT_NATIVE_ENCODE={auto,on,off}); ctypes releases the GIL
+ * around every call, so the encode worker pool gets real parallelism.
+ * Parity contract: bit-identical words/fold values and the SAME typed
+ * errors as the pure-Python path on every input, malformed included —
+ * enforced by tests/test_native_encode.py and fuzzed (with ASan/UBSan
+ * in `make sanitize-selftest`) by native/encode_fuzz.c.  The symbol
+ * surface below is cross-checked against encode.c by
+ * tools/comm_parity.py, like comm.h's.
+ */
+#ifndef ENCODE_H
+#define ENCODE_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+/* Status codes.  The ctypes shim maps each to the exception class the
+ * pure-Python path raises for the same input (parity is by TYPE):
+ * ENC_EBADTOK -> ValueError, ENC_ERANGE -> OverflowError,
+ * ENC_EMAGIC / ENC_EHDR -> ValueError with io.py's exact messages. */
+#define ENC_OK       0
+#define ENC_EDTYPE  (-1)  /* unsupported (kind, itemsize) pair */
+#define ENC_EBADTOK (-2)  /* malformed decimal token */
+#define ENC_ERANGE  (-3)  /* token overflows the 64-bit container */
+#define ENC_EMAGIC  (-4)  /* header does not start with SORTBIN1 */
+#define ENC_EHDR    (-5)  /* header dtype tag mismatch */
+#define ENC_ECAP    (-6)  /* out buffer too small (caller bug) */
+
+/* One-pass reduction state over a chunk's encoded words.  Word 0 is the
+ * most significant; 1-word dtypes leave the *1 slots at their neutral
+ * values.  sum/xor are the multiset fingerprint of models/verify.py
+ * (wrapping uint32); lexmax is the encoded form of the chunk's MAXIMUM
+ * key under native order (== the pad value the ingest pipeline
+ * replicates), which per-word max alone cannot provide for 2-word
+ * dtypes. */
+typedef struct {
+    uint64_t count;
+    uint32_t xor0, xor1;
+    uint32_t sum0, sum1;
+    uint32_t min0, min1;
+    uint32_t max0, max1;
+    uint32_t lexmax0, lexmax1;
+} enc_fold;
+
+/* ABI version stamp — the ctypes shim refuses a stale .so loudly
+ * instead of calling into a mismatched struct layout. */
+#define ENC_ABI_VERSION 1
+int enc_abi_version(void);
+
+/* Encode n keys of numpy dtype (kind in {'i','u','f'}, itemsize in
+ * {1,2,4,8}) from src into planar uint32 word arrays w0 (msw) and w1
+ * (lsw; ignored, may be NULL, for 1-word dtypes), folding the
+ * reductions into *fold as the values stream through.  fold_fp=0 skips
+ * the XOR/sum fingerprint components (SORT_VERIFY=0 must not pay for
+ * them), min/max/lexmax always fold.  n==0 is ENC_OK with a neutral
+ * fold.  Returns ENC_OK or ENC_EDTYPE. */
+int enc_encode_fold(const void *src, size_t n, char kind, int itemsize,
+                    uint32_t *w0, uint32_t *w1, int fold_fp,
+                    enc_fold *fold);
+
+/* Number of whitespace-separated tokens in buf[0..len) — the exact
+ * allocation size for the parse calls below (ASCII whitespace set
+ * matches Python bytes.split(): space \t \n \v \f \r). */
+long long enc_count_tokens(const char *buf, size_t len);
+
+/* Parse every whitespace-separated decimal token ([+-]?digits only,
+ * fscanf/int() common subset) into out[0..cap).  Returns the token
+ * count parsed, or a negative status; on error *bad_off is the byte
+ * offset of the offending token (for the shim's error message).
+ * enc_parse_i64 range-checks against int64 (narrower int dtypes
+ * truncate Python-side, matching toks.astype(int64).astype(dt));
+ * enc_parse_u64 is the uint64-exact path (rejects signs below zero and
+ * values >= 2^64, like numpy's str->uint64). */
+long long enc_parse_i64(const char *buf, size_t len, int64_t *out,
+                        size_t cap, size_t *bad_off);
+long long enc_parse_u64(const char *buf, size_t len, uint64_t *out,
+                        size_t cap, size_t *bad_off);
+
+/* Validate a SORTBIN1 header (16 bytes: magic, dtype kind, itemsize,
+ * pad) against the expected key dtype.  Returns ENC_OK, ENC_EMAGIC,
+ * or ENC_EHDR; on ENC_EHDR, *got_kind and *got_size carry the tag
+ * so the shim can reproduce io.py's exact mismatch message. */
+int enc_check_header(const unsigned char *hdr, size_t len, char kind,
+                     int itemsize, char *got_kind, int *got_size);
+
+#endif /* ENCODE_H */

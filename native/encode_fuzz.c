@@ -1,0 +1,231 @@
+/* encode_fuzz.c — seeded, bounded fuzz driver over the native encode
+ * kernels (ISSUE 6 satellite).  Usage: encode_fuzz <seed> <iters>.
+ *
+ * Three corpora per run, drawn from one splitmix64 stream so the SAME
+ * seed replays the SAME byte sequences in every build:
+ *
+ *  - binary: random bytes encoded as each supported dtype, fp on AND
+ *    off; the fold returned by the kernel is re-derived from the words
+ *    it wrote by an independent scalar loop and must match exactly
+ *    (catches any vectorization/UB divergence between the two);
+ *  - text: token streams mixing valid decimals (all widths, both
+ *    signs, container-boundary values), oversized numbers and garbage
+ *    bytes; enc_count_tokens must agree with the parse count on
+ *    success, and error statuses/offsets fold into the checksum;
+ *  - header: random and near-valid 16-byte SORTBIN1 headers.
+ *
+ * Everything folds into one checksum printed at exit:
+ * `make sanitize-selftest` runs this under ASan+UBSan and as a plain
+ * build and requires identical output (the cross-build differential),
+ * with the shared suppressions file empty by policy.  Any internal
+ * inconsistency exits 1 immediately.
+ */
+#include <inttypes.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "encode.h"
+
+static uint64_t sm_state;
+
+static uint64_t sm_next(void) {
+    uint64_t z = (sm_state += 0x9E3779B97F4A7C15ULL);
+    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+    z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+    return z ^ (z >> 31);
+}
+
+static uint64_t checksum;
+
+static void fold_u64(uint64_t v) {
+    checksum = (checksum ^ v) * 0x100000001B3ULL;  /* FNV-ish mix */
+}
+
+static void die(const char *what, uint64_t iter) {
+    fprintf(stderr, "encode_fuzz: INVARIANT VIOLATION: %s (iter %" PRIu64
+            ")\n", what, iter);
+    exit(1);
+}
+
+/* independent scalar re-derivation of the fold from the written words */
+static void check_fold_against_words(const uint32_t *w0, const uint32_t *w1,
+                                     size_t n, int two, const enc_fold *f,
+                                     int fp, uint64_t iter) {
+    uint32_t mn0 = 0xFFFFFFFFu, mx0 = 0, xr0 = 0, sm0 = 0;
+    uint32_t mn1 = 0xFFFFFFFFu, mx1 = 0, xr1 = 0, sm1 = 0;
+    uint64_t lex = 0;
+    for (size_t i = 0; i < n; i++) {
+        uint32_t hi = w0[i], lo = two ? w1[i] : 0;
+        if (hi < mn0) mn0 = hi;
+        if (hi > mx0) mx0 = hi;
+        xr0 ^= hi; sm0 += hi;
+        if (two) {
+            if (lo < mn1) mn1 = lo;
+            if (lo > mx1) mx1 = lo;
+            xr1 ^= lo; sm1 += lo;
+            uint64_t u = ((uint64_t)hi << 32) | lo;
+            if (u > lex) lex = u;
+        }
+    }
+    if (f->count != (uint64_t)n) die("fold count", iter);
+    if (n == 0) return;
+    if (f->min0 != mn0 || f->max0 != mx0) die("word0 min/max", iter);
+    if (fp && (f->xor0 != xr0 || f->sum0 != sm0)) die("word0 fp", iter);
+    if (two) {
+        if (f->min1 != mn1 || f->max1 != mx1) die("word1 min/max", iter);
+        if (fp && (f->xor1 != xr1 || f->sum1 != sm1)) die("word1 fp", iter);
+        if (f->lexmax0 != (uint32_t)(lex >> 32) ||
+            f->lexmax1 != (uint32_t)(lex & 0xFFFFFFFFu))
+            die("lexmax", iter);
+    } else if (f->lexmax0 != mx0) {
+        die("lexmax (1w)", iter);
+    }
+}
+
+static const struct { char kind; int size; } DTYPES[] = {
+    {'i', 1}, {'u', 1}, {'i', 2}, {'u', 2}, {'i', 4}, {'u', 4},
+    {'i', 8}, {'u', 8}, {'f', 4}, {'f', 8},
+};
+
+#define MAX_N 4096
+
+static void fuzz_binary(uint64_t iter) {
+    size_t n = (size_t)(sm_next() % (MAX_N + 1));
+    unsigned d = (unsigned)(sm_next() % 10u);
+    char kind = DTYPES[d].kind;
+    int isz = DTYPES[d].size;
+    uint64_t *src = (uint64_t *)malloc((n ? n : 1) * 8u);
+    uint32_t *w0 = (uint32_t *)malloc((n ? n : 1) * 4u);
+    uint32_t *w1 = (uint32_t *)malloc((n ? n : 1) * 4u);
+    if (!src || !w0 || !w1) die("malloc", iter);
+    for (size_t i = 0; i < (n * (size_t)isz + 7) / 8; i++)
+        src[i] = sm_next();
+    int fp = (int)(sm_next() & 1u);
+    enc_fold f;
+    int rc = enc_encode_fold(src, n, kind, isz, w0, w1, fp, &f);
+    if (rc != ENC_OK) die("encode rc", iter);
+    int two = isz == 8;
+    /* fp=0 still folds min/max/lexmax; re-derive with fp checking only
+     * when the kernel was asked to fold it */
+    check_fold_against_words(w0, w1, n, two, &f, fp, iter);
+    fold_u64(f.count); fold_u64(((uint64_t)f.xor0 << 32) | f.sum0);
+    fold_u64(((uint64_t)f.min0 << 32) | f.max0);
+    fold_u64(((uint64_t)f.lexmax0 << 32) | f.lexmax1);
+    for (size_t i = 0; i < n; i += 97)
+        fold_u64(w0[i]);
+    /* unsupported dtype probe must never write */
+    if (enc_encode_fold(src, n, 'c', 8, w0, w1, 1, &f) != ENC_EDTYPE)
+        die("EDTYPE", iter);
+    free(src); free(w0); free(w1);
+}
+
+static void fuzz_text(uint64_t iter) {
+    char buf[2048];
+    size_t len = 0;
+    unsigned n_toks = (unsigned)(sm_next() % 64u);
+    for (unsigned t = 0; t < n_toks && len + 64 < sizeof buf; t++) {
+        uint64_t r = sm_next() % 16u;
+        if (r == 0) {                     /* mixed digit/letter garbage:
+                                           * the mid-token ENC_EBADTOK
+                                           * branch ("12a3") */
+            unsigned gl = (unsigned)(sm_next() % 8u) + 1u;
+            for (unsigned i = 0; i < gl; i++)
+                buf[len++] = (sm_next() & 1u)
+                    ? (char)('0' + (int)(sm_next() % 10u))
+                    : (char)('a' + (int)(sm_next() % 26u));
+        } else if (r == 1) {              /* bare sign token */
+            buf[len++] = (sm_next() & 1u) ? '-' : '+';
+        } else {                          /* decimal: maybe signed,
+                                           * maybe oversized, maybe
+                                           * underscore-grouped (legal
+                                           * AND illegal placements) */
+            if (sm_next() & 1u)
+                buf[len++] = (sm_next() & 1u) ? '-' : '+';
+            unsigned dl = (unsigned)(sm_next() % 24u) + 1u;
+            for (unsigned i = 0; i < dl; i++) {
+                buf[len++] = (char)('0' + (int)(sm_next() % 10u));
+                if (sm_next() % 8u == 0)
+                    buf[len++] = '_';    /* sometimes trailing = bad */
+            }
+        }
+        buf[len++] = (sm_next() & 1u) ? ' ' : '\n';
+    }
+    long long cnt = enc_count_tokens(buf, len);
+    if (cnt < 0 || (uint64_t)cnt > len) die("count_tokens", iter);
+    size_t cap = (size_t)cnt;
+    int64_t *oi = (int64_t *)malloc((cap ? cap : 1) * 8u);
+    uint64_t *ou = (uint64_t *)malloc((cap ? cap : 1) * 8u);
+    if (!oi || !ou) die("malloc", iter);
+    size_t bad = 0;
+    long long ri = enc_parse_i64(buf, len, oi, cap, &bad);
+    if (ri >= 0) {
+        if (ri != cnt) die("i64 count mismatch", iter);
+        for (long long i = 0; i < ri; i++)
+            fold_u64((uint64_t)oi[i]);
+    } else {
+        if (ri == ENC_ECAP || bad >= len) die("i64 error shape", iter);
+        fold_u64((uint64_t)(-ri) ^ (uint64_t)bad);
+    }
+    long long ru = enc_parse_u64(buf, len, ou, cap, &bad);
+    if (ru >= 0) {
+        if (ru != cnt) die("u64 count mismatch", iter);
+        for (long long i = 0; i < ru; i++)
+            fold_u64(ou[i]);
+    } else {
+        if (ru == ENC_ECAP || bad >= len) die("u64 error shape", iter);
+        fold_u64((uint64_t)(-ru) ^ (uint64_t)bad);
+    }
+    free(oi); free(ou);
+}
+
+static void fuzz_header(uint64_t iter) {
+    unsigned char hdr[16];
+    uint64_t r = sm_next();
+    if (r & 1u)
+        memcpy(hdr, "SORTBIN1", 8);
+    else
+        for (int i = 0; i < 8; i++)
+            hdr[i] = (unsigned char)sm_next();
+    hdr[8] = (unsigned char)"iufc"[sm_next() % 4u];
+    hdr[9] = (unsigned char)(sm_next() % 12u);
+    for (int i = 10; i < 16; i++)
+        hdr[i] = (unsigned char)sm_next();
+    char gk = 0;
+    int gs = 0;
+    unsigned d = (unsigned)(sm_next() % 10u);
+    int rc = enc_check_header(hdr, sizeof hdr, DTYPES[d].kind,
+                              DTYPES[d].size, &gk, &gs);
+    if (rc != ENC_OK && rc != ENC_EMAGIC && rc != ENC_EHDR)
+        die("header rc", iter);
+    /* truncated header is never OK */
+    if (enc_check_header(hdr, 8, DTYPES[d].kind, DTYPES[d].size,
+                         &gk, &gs) == ENC_OK)
+        die("short header accepted", iter);
+    fold_u64((uint64_t)(uint32_t)(int32_t)rc ^ (r << 8));
+}
+
+int main(int argc, char **argv) {
+    if (argc != 3) {
+        fprintf(stderr, "Usage: %s <seed> <iters>\n", argv[0]);
+        return 2;
+    }
+    uint64_t seed = (uint64_t)strtoull(argv[1], NULL, 10);
+    uint64_t iters = (uint64_t)strtoull(argv[2], NULL, 10);
+    sm_state = seed;
+    checksum = 0xCBF29CE484222325ULL;
+    if (enc_abi_version() != ENC_ABI_VERSION) {
+        fprintf(stderr, "encode_fuzz: ABI mismatch\n");
+        return 1;
+    }
+    for (uint64_t i = 0; i < iters; i++) {
+        switch (sm_next() % 3u) {
+        case 0: fuzz_binary(i); break;
+        case 1: fuzz_text(i); break;
+        default: fuzz_header(i); break;
+        }
+    }
+    printf("encode_fuzz seed=%" PRIu64 " iters=%" PRIu64
+           " checksum=%016" PRIx64 "\n", seed, iters, checksum);
+    return 0;
+}

@@ -1,0 +1,298 @@
+"""Native encode engine parity suite (ISSUE 6).
+
+The one correctness contract of ``native/encode.c`` +
+``mpitest_tpu/utils/native_encode.py``: for EVERY input — all ten
+supported dtypes, randomized values, adversarial float payloads,
+malformed text, wrong headers, chunk-boundary token splits — the native
+engine must produce **bit-identical** outputs to the pure-Python engine
+(words, per-word min/max, pad key, fingerprint) and raise the **same
+typed errors** where the Python path raises.  The Python engine is the
+oracle; ``SORT_NATIVE_ENCODE=off`` must therefore preserve seed
+behavior exactly by construction.
+
+Builds the engine library on demand (one small cc invocation, like the
+other native tests build their binaries); skips — loudly, via the
+standard marker — only when no C compiler exists.
+"""
+
+from __future__ import annotations
+
+import shutil
+
+import numpy as np
+import pytest
+
+from mpitest_tpu.ops.keys import codec_for
+from mpitest_tpu.utils import io, knobs, native_encode
+
+ALL_DTYPES = [np.int8, np.uint8, np.int16, np.uint16, np.int32, np.uint32,
+              np.int64, np.uint64, np.float32, np.float64]
+
+INT_DTYPES = [np.int8, np.uint8, np.int16, np.uint16, np.int32, np.uint32,
+              np.int64, np.uint64]
+
+
+@pytest.fixture(scope="module", autouse=True)
+def engine_lib():
+    """Build + load the native library once for the module."""
+    if shutil.which("cc") is None and shutil.which("gcc") is None:
+        pytest.skip("no C compiler on this image")
+    if not native_encode.build():
+        pytest.skip(f"libencode build failed: "
+                    f"{native_encode.unavailable_reason()}")
+    assert native_encode.available()
+
+
+def _chunks(dtype, sizes=(1, 7, 1024, 4097), seed=5):
+    dt = np.dtype(dtype)
+    for i, n in enumerate(sizes):
+        x = io.generate("uniform", n, dt, seed=seed + i)
+        if dt.kind == "f" and n >= 8:
+            x[:6] = [np.nan, -np.nan, -0.0, 0.0, np.inf, -np.inf]
+        yield x
+
+
+@pytest.mark.parametrize("dtype", ALL_DTYPES)
+def test_encode_fold_parity(dtype):
+    """words + min/max + pad key + fingerprint bit-identical across
+    engines, randomized chunks at several sizes, both fold_fp modes."""
+    codec = codec_for(np.dtype(dtype))
+    for x in _chunks(dtype):
+        for fold_fp in (True, False):
+            wn, ln, hn, mn, fn = native_encode.encode_and_fold(
+                x, codec, fold_fp, "native")
+            wp, lp, hp, mp, fp = native_encode.encode_and_fold(
+                x, codec, fold_fp, "python")
+            assert len(wn) == len(wp) == codec.n_words
+            for a, b in zip(wn, wp):
+                assert a.dtype == np.uint32
+                np.testing.assert_array_equal(a, b)
+            assert ln == lp and hn == hp
+            if not fold_fp:
+                assert fn is None and fp is None
+            else:
+                assert fn == fp
+            if np.dtype(dtype).kind == "f":
+                assert mn is None and mp is None
+            else:
+                # same value AND same native dtype (the pad encode
+                # re-encodes this scalar; a widened type would differ)
+                assert mn == mp
+                assert np.asarray(mn).dtype == np.asarray(mp).dtype
+
+
+def test_encode_fold_empty_chunk_rejected():
+    """n==0 has no min/max/pad: the SAME ValueError from both engines
+    (the Python path would crash in w.min(), the native path would
+    return inverted neutral folds — neither may leak out)."""
+    codec = codec_for(np.dtype(np.int32))
+    for eng in ("native", "python"):
+        with pytest.raises(ValueError, match="empty chunk"):
+            native_encode.encode_and_fold(np.empty(0, np.int32),
+                                          codec, True, eng)
+
+
+def test_load_is_thread_safe():
+    """Concurrent first resolutions all see the completed verdict —
+    never a half-written (_LOADED, _LIB) pair (a spurious 'unavailable'
+    would silently degrade an auto run)."""
+    import threading
+
+    native_encode._LOADED = False
+    native_encode._LIB = None
+    native_encode._LIB_ERR = None
+    results: list = []
+    barrier = threading.Barrier(8)
+
+    def resolve() -> None:
+        barrier.wait()
+        results.append(native_encode.engine())
+
+    threads = [threading.Thread(target=resolve) for _ in range(8)]
+    with knobs.scoped_env(SORT_NATIVE_ENCODE="auto"):
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert results == ["native"] * 8
+
+
+def test_encode_fold_noncontiguous_input():
+    """Strided views normalize before the C call (same values out)."""
+    codec = codec_for(np.dtype(np.int32))
+    base = io.generate("uniform", 2048, np.int32, seed=1)
+    view = base[::2]
+    assert not view.flags.c_contiguous
+    wn, ln, hn, mn, fn = native_encode.encode_and_fold(
+        view, codec, True, "native")
+    wp, lp, hp, mp, fp = native_encode.encode_and_fold(
+        np.ascontiguousarray(view), codec, True, "python")
+    np.testing.assert_array_equal(wn[0], wp[0])
+    assert (ln, hn, mn, fn) == (lp, hp, mp, fp)
+
+
+def test_encode_fold_misaligned_input():
+    """A contiguous-but-misaligned buffer (np.frombuffer at an odd
+    offset) normalizes before the C call — unaligned 64-bit loads in
+    the kernel would be UB."""
+    codec = codec_for(np.dtype(np.int64))
+    raw = io.generate("uniform", 257, np.int64, seed=8).tobytes()
+    mis = np.frombuffer(b"\0" * 4 + raw, dtype=np.int64, offset=4)
+    assert mis.flags.c_contiguous and not mis.flags.aligned
+    wn, ln, hn, mn, fn = native_encode.encode_and_fold(
+        mis, codec, True, "native")
+    wp, lp, hp, mp, fp = native_encode.encode_and_fold(
+        np.ascontiguousarray(mis), codec, True, "python")
+    for a, b in zip(wn, wp):
+        np.testing.assert_array_equal(a, b)
+    assert (ln, hn, mn, fn) == (lp, hp, mp, fp)
+
+
+@pytest.mark.parametrize("dtype", INT_DTYPES)
+def test_parse_parity_valid(dtype):
+    """Randomized valid decimal streams parse to identical arrays,
+    dtype truncation semantics included."""
+    dt = np.dtype(dtype)
+    x = io.generate("uniform", 1500, dt, seed=17)
+    block = ("\n".join(str(v) for v in x.tolist())
+             + " +17 -0 0 \t 9 ").encode()
+    a = native_encode.parse_text_tokens(block, dt, "native")
+    b = native_encode.parse_text_tokens(block, dt, "python")
+    assert a.dtype == dt == b.dtype
+    np.testing.assert_array_equal(a, b)
+
+
+def test_parse_parity_boundaries():
+    cases = [
+        (b"9223372036854775807 -9223372036854775808", np.int64),
+        (b"18446744073709551615 0 -0", np.uint64),
+        (b"2147483648 -2147483649", np.int32),  # int64-truncation wrap
+        (b"", np.int64),
+        (b"   \n\t ", np.int64),
+        # PEP-515 underscores: the Python engine's cast routes through
+        # int(), which ACCEPTS digit-grouping underscores — so must C
+        (b"1_0 1_000_000 +4_2 -9_9", np.int64),
+        (b"18_446_744_073_709_551_615", np.uint64),
+    ]
+    for blk, dt in cases:
+        a = native_encode.parse_text_tokens(blk, np.dtype(dt), "native")
+        b = native_encode.parse_text_tokens(blk, np.dtype(dt), "python")
+        np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.parametrize("block,dtype,exc", [
+    (b"1 abc 3", np.int64, ValueError),           # truncated/garbage token
+    (b"1.5", np.int32, ValueError),               # float literal
+    (b"0x10", np.int32, ValueError),              # non-decimal base
+    (b"--3", np.int32, ValueError),               # doubled sign
+    (b"+ 1", np.int32, ValueError),               # bare sign token
+    (b"1__0", np.int32, ValueError),              # doubled underscore
+    (b"1_", np.int32, ValueError),                # trailing underscore
+    (b"_1", np.int32, ValueError),                # leading underscore
+    (b"99999999999999999999x", np.int64, ValueError),   # garbage outranks
+    (b"99999999999999999999999_", np.int64, ValueError),  # ...overflow
+    (b"99999999999999999999999", np.int64, OverflowError),
+    (b"9223372036854775808", np.int64, OverflowError),
+    (b"-9223372036854775809", np.int64, OverflowError),
+    (b"-1", np.uint64, OverflowError),
+    (b"18446744073709551616", np.uint64, OverflowError),
+])
+def test_parse_same_typed_errors(block, dtype, exc):
+    """Malformed input raises the SAME exception type from both engines
+    (the ISSUE 6 parity-gate contract for error paths)."""
+    for eng in ("native", "python"):
+        with pytest.raises(exc):
+            native_encode.parse_text_tokens(block, np.dtype(dtype), eng)
+
+
+@pytest.mark.parametrize("dtype", [np.int32, np.uint64])
+def test_chunk_boundary_splits_native(dtype, tmp_path):
+    """iter_key_chunks under the FORCED native engine with block
+    boundaries landing mid-token: concatenation equals the monolithic
+    read (the carry logic feeds whole tokens to the C parser)."""
+    dt = np.dtype(dtype)
+    x = io.generate("uniform", 1000, dt, seed=11)
+    p = str(tmp_path / "keys.txt")
+    io.write_keys_text(p, x)
+    with knobs.scoped_env(SORT_NATIVE_ENCODE="on"):
+        chunks = list(io.iter_key_chunks(p, dt, chunk_elems=3))
+    assert len(chunks) > 10
+    np.testing.assert_array_equal(np.concatenate(chunks), x)
+    with knobs.scoped_env(SORT_NATIVE_ENCODE="off"):
+        ref = list(io.iter_key_chunks(p, dt, chunk_elems=3))
+    np.testing.assert_array_equal(np.concatenate(ref),
+                                  np.concatenate(chunks))
+
+
+def test_header_parity(tmp_path):
+    """SORTBIN1 header validation: identical ValueError MESSAGES from
+    both engines for bad magic, wrong kind, wrong width; reads through
+    io.py hit the engine-dispatched check."""
+    good = io.BIN_MAGIC + b"i" + bytes([4]) + b"\0" * 6
+    bad_magic = b"SORTBIN9" + b"i" + bytes([4]) + b"\0" * 6
+    wrong_kind = io.BIN_MAGIC + b"u" + bytes([4]) + b"\0" * 6
+    wrong_size = io.BIN_MAGIC + b"i" + bytes([8]) + b"\0" * 6
+    garbage_kind = io.BIN_MAGIC + bytes([0xFF, 4]) + b"\0" * 6
+    for hdr in (bad_magic, wrong_kind, wrong_size, garbage_kind):
+        msgs = []
+        for eng in ("native", "python"):
+            try:
+                native_encode.check_bin_header(hdr, "f.bin",
+                                               np.dtype(np.int32), eng)
+                msgs.append(None)
+            except ValueError as e:
+                msgs.append(str(e))
+        assert msgs[0] is not None and msgs[0] == msgs[1], (hdr, msgs)
+    for eng in ("native", "python"):
+        native_encode.check_bin_header(good, "f.bin", np.dtype(np.int32),
+                                       eng)  # no raise
+    # end to end through the reader, engine forced on: same hard error
+    p = str(tmp_path / "k.bin")
+    io.write_keys_binary(p, np.arange(10, dtype=np.int32))
+    with knobs.scoped_env(SORT_NATIVE_ENCODE="on"):
+        with pytest.raises(ValueError, match="holds i32 keys, not int64"):
+            io.read_keys_binary(p, np.int64)
+
+
+def test_knob_selects_engine(monkeypatch):
+    """off -> python; on without a loadable library -> loud RuntimeError
+    (never a silent fallback); auto without the library -> python."""
+    with knobs.scoped_env(SORT_NATIVE_ENCODE="off"):
+        assert native_encode.engine() == "python"
+    with knobs.scoped_env(SORT_NATIVE_ENCODE="on"):
+        assert native_encode.engine() == "native"
+    # simulate a missing/stale library
+    monkeypatch.setattr(native_encode, "_LOADED", True)
+    monkeypatch.setattr(native_encode, "_LIB", None)
+    monkeypatch.setattr(native_encode, "_LIB_ERR", "forced by test")
+    with knobs.scoped_env(SORT_NATIVE_ENCODE="auto"):
+        assert native_encode.engine() == "python"
+    with knobs.scoped_env(SORT_NATIVE_ENCODE="on"):
+        with pytest.raises(RuntimeError, match="forced by test"):
+            native_encode.engine()
+    with knobs.scoped_env(SORT_NATIVE_ENCODE="garbage"):
+        with pytest.raises(ValueError, match="SORT_NATIVE_ENCODE="):
+            native_encode.engine()
+
+
+def test_streamed_pipeline_parity_across_engines(mesh4, tmp_path):
+    """The full streamed pipeline (mmap -> encode pool -> sharded words)
+    lands bit-identical device words, fingerprint and planner diffs
+    under both engines, and the chosen engine is visible in the stats."""
+    from mpitest_tpu.models.ingest import stream_to_mesh
+
+    x = io.generate("uniform", 50_000, np.int32, seed=23)
+    p = str(tmp_path / "k.bin")
+    io.write_keys_binary(p, x)
+    staged = {}
+    for mode in ("off", "on"):
+        with knobs.scoped_env(SORT_NATIVE_ENCODE=mode,
+                              SORT_INGEST_CHUNK="9000"):
+            mm = io.open_keys_mmap(p)
+            staged[mode] = stream_to_mesh(mm, mesh4)
+    assert staged["off"].stats.encode_engine == "python"
+    assert staged["on"].stats.encode_engine == "native"
+    assert staged["off"].fingerprint == staged["on"].fingerprint
+    assert staged["off"].word_diffs == staged["on"].word_diffs
+    for a, b in zip(staged["off"].words, staged["on"].words):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

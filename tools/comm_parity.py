@@ -37,12 +37,20 @@ REPO = Path(__file__).resolve().parents[1]
 _DECL_RE = re.compile(r"^\s*(?:int|void|double)\s+(comm_\w+)\s*\(",
                       re.MULTILINE)
 
+#: native/encode.h symbols (the ingest-engine surface, ISSUE 6): every
+#: declared enc_* function must be defined in encode.c, or the ctypes
+#: shim's _bind() dies at runtime in whichever job first loads the .so.
+_ENC_DECL_RE = re.compile(
+    r"^\s*(?:int|void|long long|size_t)\s+(enc_\w+)\s*\(", re.MULTILINE)
+
+
 #: A function DEFINITION: return type + name + ( ... with no trailing ';'
 #: on the prototype line run (brace may sit on a later line).
-def _defined_symbols(src: str) -> set[str]:
+def _defined_symbols(src: str,
+                     pattern: str = r"comm_\w+|MPI_\w+") -> set[str]:
     out = set()
     for m in re.finditer(
-            r"^[A-Za-z_][\w\s\*]*?\b(comm_\w+|MPI_\w+)\s*\(", src,
+            r"^[A-Za-z_][\w\s\*]*?\b(" + pattern + r")\s*\(", src,
             re.MULTILINE):
         # walk to the matching ')' then check for '{' (definition) vs ';'
         i = m.end() - 1
@@ -173,6 +181,24 @@ def main() -> int:
                 errors.append(f"comm/mpi_stub/{name}: {fn} (called by "
                               "comm_mpi.c) is not implemented")
 
+    # Ingest-engine surface (ISSUE 6): encode.h declarations must all be
+    # defined in encode.c (the ctypes shim binds every one at load), and
+    # encode.c must not define enc_* API surface the header hides.
+    enc_h = (REPO / "native" / "encode.h").read_text()
+    enc_declared = sorted(set(_ENC_DECL_RE.findall(enc_h)))
+    if not enc_declared:
+        errors.append("native/encode.h: no enc_* declarations parsed")
+    enc_defined = _defined_symbols(
+        _strip_comments((REPO / "native" / "encode.c").read_text()),
+        pattern=r"enc_\w+")
+    for sym in enc_declared:
+        if sym not in enc_defined:
+            errors.append(f"native/encode.c: declared symbol {sym} has "
+                          "no definition")
+    for sym in sorted(enc_defined - set(enc_declared)):
+        errors.append(f"native/encode.c: defines {sym} which encode.h "
+                      "does not declare (shim-invisible API surface)")
+
     # Sorter call-sequences + the deadlock smell.
     for sorter in ("native/sample_sort.c", "native/radix_sort.c"):
         p = REPO / sorter
@@ -194,7 +220,8 @@ def main() -> int:
         print(f"[PARITY] {e}", file=sys.stderr)
     print(f"comm parity: {len(errors)} mismatch(es); "
           f"{len(declared)} comm.h symbols x {len(backends)} backends, "
-          f"{len(called)} MPI calls x 2 runtimes checked")
+          f"{len(called)} MPI calls x 2 runtimes, "
+          f"{len(enc_declared)} encode.h symbols checked")
     return 1 if errors else 0
 
 

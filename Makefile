@@ -33,6 +33,13 @@
 #                  native encode >= 2x the Python engine on this host
 #   make native-encode — build native/libencode.so (the C ingest
 #                  engine behind SORT_NATIVE_ENCODE, ISSUE 6)
+#   make multichip-selftest — the scale-out gate (ISSUE 7), on a
+#                  virtual 8-device CPU mesh so it runs on any image:
+#                  8-device output bit-identical to 1-device for both
+#                  algorithms (uniform / N<P / non-divisible / skewed),
+#                  per-rank exchange-byte imbalance under the gate, and
+#                  negotiated capacity strictly below the worst-case
+#                  cap with zero overflow retries on skewed inputs
 #   make lint    — static analysis (ISSUE 4): sortlint (the project's
 #                  custom AST rules — env-knob registry, span schema,
 #                  SPMD safety, fault coverage, typed core), the
@@ -57,8 +64,8 @@
 PYTHON ?= python3
 
 .PHONY: test native native-encode chip-test telemetry-selftest \
-    ingest-selftest fault-selftest lint cwarn-check typecheck tidy-check \
-    knob-docs sanitize-selftest clean
+    ingest-selftest fault-selftest multichip-selftest lint cwarn-check \
+    typecheck tidy-check knob-docs sanitize-selftest clean
 
 chip-test:
 	$(PYTHON) -u bench/chip_regression.py
@@ -107,6 +114,14 @@ fault-selftest:
 	JAX_PLATFORMS=cpu \
 	    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 	    $(PYTHON) -u bench/fault_selftest.py
+
+# The scale-out gate (ISSUE 7) — see bench/multichip_selftest.py.
+# Virtual 8-device CPU mesh: runs on any image; identical shard_map
+# code drives real chips.
+multichip-selftest:
+	JAX_PLATFORMS=cpu \
+	    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	    $(PYTHON) -u bench/multichip_selftest.py
 
 # Proof the streamed ingest pipeline is live, overlapping, and fast
 # (ISSUE 6): the NATIVE encode engine is built and FORCED ON for every

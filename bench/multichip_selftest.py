@@ -1,0 +1,134 @@
+"""Multi-chip scale-out selftest: the `make multichip-selftest` gate (ISSUE 7).
+
+The north-star path is the P-device sharded sort, so its three
+load-bearing claims are gated here, TPU-free on a virtual 8-device CPU
+mesh (the identical shard_map/collective code drives real chips):
+
+1. **Bit-identical output** — the 8-device sharded sort equals the
+   1-device result byte for byte, for both algorithms, across uniform,
+   N<P, non-divisible-N and skewed (clustered / duplicate-heavy)
+   inputs.  Sorted output is canonical; any divergence is a sharding or
+   exchange bug, never an acceptable difference.
+2. **Exchange balance** — after the count probe (and the skew re-stage
+   it may trigger), per-rank received exchange bytes stay within
+   :data:`BALANCE_GATE` x the mean, and no single peer segment needs
+   more than :data:`BALANCE_GATE` x the fair share.
+3. **Capacity negotiation** — on a skewed input the negotiated capacity
+   is STRICTLY below the worst-case cap (the shard size), and the
+   exchange completes with ZERO overflow retries (the probe made the
+   recompile-on-overflow path unnecessary, not just rarer).
+
+Every cell failure prints loudly and the process exits nonzero — this
+runs in CI beside the ingest/fault/telemetry selftests.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+# Fail-fast supervisor pinning (like bench.py): the gate must see the
+# real scale-out path, never a silently degraded ladder rung.
+os.environ.setdefault("SORT_FALLBACK", "0")
+os.environ.setdefault("SORT_MAX_RETRIES", "0")
+
+from mpitest_tpu.utils.platform import ensure_virtual_cpu_devices  # noqa: E402
+
+ensure_virtual_cpu_devices(8)
+
+import numpy as np  # noqa: E402
+
+from mpitest_tpu.models.api import sort  # noqa: E402
+from mpitest_tpu.parallel.mesh import make_mesh  # noqa: E402
+from mpitest_tpu.utils import knobs  # noqa: E402
+from mpitest_tpu.utils.metrics import Metrics  # noqa: E402
+from mpitest_tpu.utils.trace import Tracer  # noqa: E402
+
+#: Max allowed per-rank exchange imbalance after probe/re-stage: both
+#: the recv-byte max/mean ratio and the peer-segment/fair-share ratio.
+BALANCE_GATE = 2.0
+
+results: list[tuple[str, bool, str]] = []
+
+
+def cell(name: str, ok: bool, detail: str) -> None:
+    results.append((name, ok, detail))
+    marker = "ok  " if ok else "FAIL"
+    print(f"[{marker}] {name}: {detail}", flush=True)
+
+
+def main() -> int:
+    t_start = time.perf_counter()
+    rng = np.random.default_rng(7)
+    mesh8 = make_mesh(8)
+    mesh1 = make_mesh(1)
+
+    # ---- 1. bit-identical parity: devices=8 vs devices=1 ------------
+    inputs = {
+        "uniform": rng.integers(-2**31, 2**31 - 1, size=1 << 15,
+                                dtype=np.int32),
+        "n_lt_p": rng.integers(0, 100, size=3, dtype=np.int32),
+        "non_divisible": rng.integers(-2**31, 2**31 - 1, size=1000,
+                                      dtype=np.int32),
+        "sorted_skew": np.sort(rng.integers(0, 1 << 16, size=1 << 15)
+                               .astype(np.int32)),
+        "duplicate_skew": rng.choice(
+            np.asarray([3, 7, 7, 7, 42], np.int32), size=1 << 14),
+    }
+    for algo in ("radix", "sample"):
+        for name, x in inputs.items():
+            out8 = sort(x, algorithm=algo, mesh=mesh8)
+            out1 = sort(x, algorithm=algo, mesh=mesh1)
+            same = (np.array_equal(out8, out1)
+                    and out8.tobytes() == out1.tobytes())
+            cell(f"parity/{algo}/{name}", same,
+                 "8-device output bit-identical to 1-device"
+                 if same else "OUTPUT DIVERGED between mesh sizes")
+
+    # ---- 2+3. balance + negotiated capacity on skewed inputs --------
+    skewed = inputs["sorted_skew"]
+    for algo in ("radix", "sample"):
+        tracer = Tracer()
+        out = sort(skewed, algorithm=algo, mesh=mesh8, tracer=tracer)
+        c = tracer.counters
+        ok_sorted = np.array_equal(out, skewed)
+        cell(f"skew/{algo}/correct", ok_sorted, "sorted output exact")
+        neg = c.get("negotiated_cap")
+        worst = c.get("worst_cap")
+        ok_neg = neg is not None and worst and neg < worst
+        cell(f"skew/{algo}/negotiated_below_worst", bool(ok_neg),
+             f"negotiated cap {neg} vs worst-case {worst}")
+        retries = int(c.get("exchange_retries", 0))
+        cell(f"skew/{algo}/no_overflow_retry", retries == 0,
+             f"exchange_retries={retries} (probe sized the cap exactly)"
+             if retries == 0 else
+             f"exchange_retries={retries} — negotiation failed to size "
+             "the cap")
+        balance = float(c.get("exchange_balance_ratio", np.inf))
+        peer = float(c.get("exchange_peer_ratio", np.inf))
+        ok_bal = balance <= BALANCE_GATE and peer <= BALANCE_GATE
+        cell(f"skew/{algo}/balance_under_gate", ok_bal,
+             f"recv max/mean {balance} and peer/fair {peer} "
+             f"(gate {BALANCE_GATE}) — restaged={int(c.get('skew_restage', 0))}")
+
+    # ---- summary + metrics sidecar ----------------------------------
+    bad = [r for r in results if not r[1]]
+    wall = time.perf_counter() - t_start
+    m = Metrics(config={"selftest": "multichip", "devices": 8})
+    m.record("multichip_cells", len(results))
+    m.record("multichip_failures", len(bad))
+    m.record("multichip_wall_s", round(wall, 2), "s")
+    m.dump(knobs.get("SORT_METRICS"))
+    print(f"\nmultichip-selftest: {len(results) - len(bad)}/{len(results)} "
+          f"cells passed in {wall:.1f}s "
+          f"({'OK' if not bad else 'FAILURES ABOVE'})")
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -128,6 +128,8 @@ def main(argv: list[str] | None = None) -> int:
             "SORT_DONATE", "SORT_NATIVE_ENCODE", "SORT_VERIFY",
             "SORT_MAX_RETRIES", "SORT_RETRY_BACKOFF", "SORT_FALLBACK",
             "SORT_FAULTS", "SORT_FAULTS_SEED", "SORT_LOCAL_ENGINE",
+            "SORT_DEVICES", "SORT_NEGOTIATE", "SORT_RESTAGE",
+            "SORT_RESTAGE_RATIO",
         )
         # resolve the encode engine NOW: SORT_NATIVE_ENCODE=on with no
         # usable libencode.so is one clean [ERROR] line here, never a

@@ -662,6 +662,83 @@ def _compile_sample(mesh: Mesh, n_words: int, n: int, cap: int,
     )
 
 
+@lru_cache(maxsize=32)
+def _compile_radix_probe(mesh: Mesh, n_words: int, n: int,
+                         digit_bits: int) -> Callable[..., Any]:
+    """Capacity-negotiation probe (ISSUE 7): the exact pass-1 per-peer
+    send-count matrix, no key movement (radix_sort.radix_probe_spmd)."""
+    n_ranks = mesh.devices.size
+
+    def f(*words: jax.Array) -> jax.Array:
+        return radix_sort.radix_probe_spmd(words, digit_bits, n_ranks)
+
+    return jax.jit(
+        compat.shard_map(
+            f, mesh=mesh, in_specs=(P(AXIS),) * n_words, out_specs=P(),
+            # the [P, P] matrix is replicated by construction (it comes
+            # out of an all_gather) but the vma checker cannot prove it
+            check_vma=False,
+        )
+    )
+
+
+@lru_cache(maxsize=32)
+def _compile_sample_probe(mesh: Mesh, n_words: int, n: int,
+                          oversample: int) -> Callable[..., Any]:
+    """Estimated splitter-repartition count matrix (sample_probe_spmd)."""
+    n_ranks = mesh.devices.size
+
+    def f(*words: jax.Array) -> jax.Array:
+        return sample_sort.sample_probe_spmd(words, n_ranks, oversample)
+
+    return jax.jit(
+        compat.shard_map(
+            f, mesh=mesh, in_specs=(P(AXIS),) * n_words, out_specs=P(),
+            check_vma=False,  # see _compile_radix_probe
+        )
+    )
+
+
+@lru_cache(maxsize=16)
+def _compile_interleave(mesh: Mesh, n_words: int,
+                        n: int) -> Callable[..., Any]:
+    """Skew-aware re-stage program (ISSUE 7): deal the global key array
+    round-robin across shards — ``new[j*n + i] = old[i*P + j]`` — so a
+    clustered arrangement (sorted/reverse-sorted input, the cap-blowing
+    case) turns into one where every shard holds a representative
+    stride of the whole distribution and per-peer exchange counts
+    collapse toward the fair share.  A pure permutation: the sorted
+    output (and the multiset fingerprint the verifier checks) is
+    bit-identical.  Costs one resharding pass over the words — paid
+    only when the measured imbalance says the exchange would otherwise
+    need a near-worst-case capacity."""
+    P_ = int(mesh.devices.size)
+
+    def f(*words: jax.Array) -> tuple[jax.Array, ...]:
+        return tuple(w.reshape(n, P_).T.reshape(-1) for w in words)
+
+    return jax.jit(f, out_shardings=key_sharding(mesh))
+
+
+#: Safety margin on the sample probe's ESTIMATED per-peer counts (its
+#: splitters are sampled, the real run's are exact local quantiles —
+#: see sample_sort.sample_probe_spmd); the radix probe is exact and
+#: needs none.
+SAMPLE_NEG_MARGIN = 1.25
+
+
+def _negotiation_enabled(n_ranks: int) -> bool:
+    """``SORT_NEGOTIATE``: capacity negotiation runs the count probe
+    before compiling the exchange (auto/on = whenever the mesh is
+    actually distributed; a 1-device mesh has no exchange to size)."""
+    return knobs.get("SORT_NEGOTIATE") != "off" and n_ranks > 1
+
+
+def _restage_enabled(n_ranks: int) -> bool:
+    """``SORT_RESTAGE``: the skew-aware re-stage is armed (P>1 only)."""
+    return knobs.get("SORT_RESTAGE") != "off" and n_ranks > 1
+
+
 def _donation_enabled() -> bool:
     """Buffer donation on the sort dispatch: ``SORT_DONATE`` ∈
     {auto, 1, 0} (validated in one place, ``utils.io.donate_setting``).
@@ -1270,20 +1347,28 @@ def _sort_impl(
                 # device-resident padded words: one tiny fused reduction
                 input_fp = vfy.fingerprint_device(words, N)
 
+    #: fair per-peer share of a shard — the ONE definition behind the
+    #: sample cap bound, the skew-reroute cap, and every scale-out
+    #: imbalance ratio (ISSUE 7).
+    fair = max(1, -(-n // n_ranks))
     base_cap = _round_cap(int(n / n_ranks * cap_factor) + 1, align)
     # Radix cap for skew reroutes: duplication that degenerates splitters
     # also concentrates a radix pass's send runs, so start at the same
     # O(n)-per-device bound the sample path enforces instead of paying
     # overflow-retry recompiles to grow there.
-    skew_cap = _round_cap(
-        min(n, SAMPLE_CAP_LIMIT_FACTOR * max(1, -(-n // n_ranks))), align
-    )
+    skew_cap = _round_cap(min(n, SAMPLE_CAP_LIMIT_FACTOR * fair), align)
     if oversample is None:
         oversample = max(2 * n_ranks - 1, 8)
     # Upper clamp: splitter quality saturates far below this, the
     # [P, oversample] sample gather replicates to every device, and
     # evenly_spaced_samples' int32 index math needs d^2 < 2^31.
     oversample = min(oversample, n, 16_384)
+
+    # ---- scale-out layer (ISSUE 7): negotiation + skew re-stage -----
+    negotiate = _negotiation_enabled(n_ranks)
+    restage_on = _restage_enabled(n_ranks)
+    restage_ratio = knobs.get("SORT_RESTAGE_RATIO")
+    _restaged = {"done": False}
 
     # Live/dead tracking of the (possibly donated) input word buffers —
     # the ONE place that knows whether the next dispatch must re-stage.
@@ -1292,11 +1377,38 @@ def _sort_impl(
     # degradation rung) rebuilds through here.
     _wstate = {"words": words, "dead": False}
 
+    def _interleave(ws: tuple) -> tuple:
+        return _traced_call(
+            tracer, "interleave",
+            _compile_interleave(mesh, codec.n_words, n), *ws)
+
     def live_words():
         if _wstate["dead"]:
-            _wstate["words"] = rebuild_words()
+            w = rebuild_words()
+            if _restaged["done"]:
+                # the run committed to the rebalanced arrangement; a
+                # rebuild (donation retry / verify re-stage) must land
+                # back on it, or the negotiated cap no longer fits
+                w = _interleave(w)
+            _wstate["words"] = w
             _wstate["dead"] = False
         return _wstate["words"]
+
+    def do_restage() -> None:
+        """Skew-aware re-stage: interleave the shards so per-peer
+        exchange counts collapse toward the fair share (see
+        _compile_interleave).  Idempotent — triggered proactively by
+        the count probe or reactively by the supervisor's regrow loop,
+        whichever detects the imbalance first."""
+        if _restaged["done"]:
+            return
+        with tracer.spans.span("restage", ranks=n_ranks, n=n):
+            _wstate["words"] = _interleave(live_words())
+            _wstate["dead"] = False
+        _restaged["done"] = True
+        tracer.count("skew_restage", 1)
+        tracer.verbose(
+            "skew re-stage: interleaved shards to rebalance the exchange")
 
     def mark_dead():
         if donate:
@@ -1332,8 +1444,68 @@ def _sort_impl(
                 _plan["p"] = (db, _passes_from_diffs(diffs, db))
         return _plan["p"]
 
+    def _balance_event(cnts: np.ndarray, algo_label: str, exact: bool,
+                       negotiated: int, restaged: bool) -> None:
+        """Fold a measured [P, P] count matrix into telemetry: the
+        ``exchange_balance`` event (per-rank send/recv byte lists + the
+        ratios) and the counters the bench/report scale-out tables
+        read.  ``recv`` imbalance is the classic per-rank exchange-byte
+        skew (radix is 1.0 by construction — destination blocks are
+        n-sized); ``peer_ratio`` (max single-peer segment over the fair
+        share) is what actually drives the capacity."""
+        wpb = 4 * codec.n_words
+        send = cnts.sum(axis=1) * wpb
+        recv = cnts.sum(axis=0) * wpb
+        rmean = float(recv.mean())
+        recv_ratio = float(recv.max()) / rmean if rmean > 0 else 1.0
+        peer_ratio = float(cnts.max()) / fair
+        tracer.spans.event(
+            "exchange_balance", algorithm=algo_label, ranks=n_ranks,
+            exact=exact, peer_max=int(cnts.max()), fair=fair,
+            negotiated_cap=negotiated, worst_cap=n,
+            send_bytes=[int(v) for v in send],
+            recv_bytes=[int(v) for v in recv],
+            recv_ratio=round(recv_ratio, 4),
+            peer_ratio=round(peer_ratio, 4), restaged=restaged)
+        tracer.counters["negotiated_cap"] = negotiated
+        tracer.counters["worst_cap"] = n
+        tracer.counters["exchange_balance_ratio"] = round(recv_ratio, 4)
+        tracer.counters["exchange_peer_ratio"] = round(peer_ratio, 4)
+
+    def _probe(kind: str, db: int | None = None) -> np.ndarray:
+        fn = (_compile_radix_probe(mesh, codec.n_words, n, db)
+              if kind == "radix" else
+              _compile_sample_probe(mesh, codec.n_words, n, oversample))
+        with tracer.phase("plan"):
+            return np.asarray(_traced_call(
+                tracer, f"{kind}_probe", fn, *live_words()))
+
+    def _negotiate(kind: str, db: int | None = None) -> np.ndarray:
+        """Run the count probe; re-stage once (and re-probe) when the
+        measured per-peer imbalance crosses the re-stage ratio.  Returns
+        the count matrix describing the arrangement the sort will
+        actually exchange."""
+        cnts = _probe(kind, db)
+        if (restage_on and not _restaged["done"]
+                and float(cnts.max()) / fair >= restage_ratio):
+            tracer.verbose(
+                f"{kind} probe: per-peer need {int(cnts.max())} >= "
+                f"{restage_ratio:g}x fair share {fair}; re-staging")
+            do_restage()
+            cnts = _probe(kind, db)
+        return cnts
+
     def run_radix(cap0: int) -> DistributedSortResult:
         db, passes = radix_plan()
+        if negotiate and passes > 0:
+            cnts = _negotiate("radix", db)
+            need = _round_cap(int(cnts.max()), align)
+            # pass 1's need is EXACT; later passes depend on the post-
+            # exchange arrangement, so multi-pass runs keep the
+            # cap_factor floor and the regrow loop as backstop instead
+            # of risking a full re-run to undercut it.
+            cap0 = need if passes == 1 else max(need, cap0)
+            _balance_event(cnts, "radix", True, cap0, _restaged["done"])
 
         def attempt(c: int):
             fn = _compile_radix(mesh, codec.n_words, n, db, c, passes,
@@ -1357,7 +1529,8 @@ def _sort_impl(
 
         out, cap = sup.exchange_loop(
             "radix", attempt, sup.squeeze_cap(cap0, align), align,
-            _round_cap, on_overflow=mark_dead)
+            _round_cap, on_overflow=mark_dead,
+            re_stage=do_restage if restage_on else None)
         tracer.count("exchange_passes", passes)
         tracer.counters["exchange_cap"] = cap  # last cap, not accumulated
         tracer.counters["digit_bits"] = db     # auto-resolved width
@@ -1382,9 +1555,27 @@ def _sort_impl(
             )
             tracer.count("sample_skew_fallback", 1)
             return run_radix(skew_cap)
-        cap_limit = _round_cap(
-            SAMPLE_CAP_LIMIT_FACTOR * max(1, -(-n // n_ranks)), align
-        )
+        cap_limit = _round_cap(SAMPLE_CAP_LIMIT_FACTOR * fair, align)
+        cap_start = base_cap
+        if negotiate:
+            cnts = _negotiate("sample")
+            # the sample probe is an ESTIMATE (sampled splitters) —
+            # margin on top, and the regrow loop stays as backstop
+            need = _round_cap(
+                int(float(cnts.max()) * SAMPLE_NEG_MARGIN) + 1, align)
+            if need > cap_limit:
+                # the estimate already busts the O(n) recv bound: route
+                # to radix NOW instead of paying a doomed full exchange
+                # to find out (the reactive ExchangeCapExceeded path
+                # below stays for what the estimate misses)
+                tracer.verbose(
+                    f"sample probe estimates cap {need} > O(n) bound "
+                    f"{cap_limit}; routing to radix (skew-immune)")
+                tracer.count("sample_skew_fallback", 1)
+                return run_radix(skew_cap)
+            cap_start = need
+            _balance_event(cnts, "sample", False, cap_start,
+                           _restaged["done"])
         spmd_engine = (_bitonic_impl() if _use_bitonic(_local_engine(),
                                                        codec.n_words, n)
                        else "lax")
@@ -1408,8 +1599,9 @@ def _sort_impl(
 
         try:
             (out, counts), cap = sup.exchange_loop(
-                "sample", attempt, sup.squeeze_cap(base_cap, align), align,
-                _round_cap, cap_limit=cap_limit, on_overflow=mark_dead)
+                "sample", attempt, sup.squeeze_cap(cap_start, align), align,
+                _round_cap, cap_limit=cap_limit, on_overflow=mark_dead,
+                re_stage=do_restage if restage_on else None)
         except ExchangeCapExceeded as e:
             tracer.verbose(
                 f"sample exchange needs cap {e.need} > O(n) bound "

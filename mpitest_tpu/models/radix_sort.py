@@ -135,6 +135,44 @@ def _send_segments(sorted_dest: jax.Array, n: int,
     return send_start, seg_end - send_start
 
 
+def radix_probe_spmd(
+    words: Words,
+    digit_bits: int,
+    n_ranks: int,
+    axis: str = AXIS,
+) -> jax.Array:
+    """Capacity-negotiation count probe (ISSUE 7): the EXACT per-peer
+    send counts of the first radix exchange, with zero key movement.
+
+    Pass 1 always works on the least-significant digit of the
+    least-significant word (the plan loop below), and its destination is
+    the exact global digit-stable position — fully determined by the
+    ``[P, bins]`` histogram matrix ``H``.  So one local digit histogram
+    plus the same tiny histogram ``all_gather`` the real pass pays
+    anyway yields, via :func:`collectives.block_send_counts`, the
+    precise capacity the ``[P, cap]`` exchange buffer needs — before any
+    buffer is allocated or any worst-case cap guessed.  (Later passes
+    depend on the post-exchange arrangement; the supervisor's regrow
+    loop remains the backstop for them.)
+
+    The histogram rides a sort + binary search rather than a scatter-add
+    (``kernels.histogram_sorted`` — scatter lowers to serialized updates
+    on TPU, ~40x slower at scale).
+
+    Returns int32[P, P], replicated: row r = counts rank r sends to each
+    peer (self included — the self block occupies exchange lanes too).
+    """
+    n = words[0].shape[0]
+    n_bins = 1 << digit_bits
+    with spans.maybe_span("negotiate_probe", algorithm="radix",
+                          ranks=n_ranks, n=n, trace_time=True):
+        d = kernels.digit_at(words[-1], 0, digit_bits)
+        h, _ = kernels.histogram_sorted(jnp.sort(d), n_bins)
+        H = coll.all_gather(h, axis)                  # [P, bins]
+        mine = coll.block_send_counts(H, n, axis)     # [P]
+        return coll.all_gather(mine, axis)            # [P, P]
+
+
 def radix_sort_spmd(
     words: Words,
     n_words: int,

@@ -65,6 +65,53 @@ def select_splitters(sorted_words: Words, n_ranks: int, oversample: int,
         return tuple(w[idx] for w in gsorted)
 
 
+def sample_probe_spmd(
+    words: Words,
+    n_ranks: int,
+    oversample: int,
+    axis: str = AXIS,
+) -> jax.Array:
+    """Capacity-negotiation count probe (ISSUE 7): ESTIMATED per-peer
+    send counts of the splitter repartition, without sorting the shard.
+
+    Splitters are picked from a sorted evenly-strided sample of the
+    (unsorted) shard — statistically the same quantile estimate the real
+    program derives from its fully-sorted shard, at a tiny fraction of
+    the cost — then one vectorized ``searchsorted`` + histogram counts
+    each destination.  Because the real run's splitters are exact local
+    quantiles and these are sampled ones, the counts are an *estimate*:
+    the caller adds a margin and keeps the supervisor's regrow loop as
+    the backstop (the radix probe, by contrast, is exact).
+
+    The strided sample is a static ``lax.slice`` (no gather index array
+    to overflow at scale), anchored so the last pick is index n-1 —
+    the same construction as the device skew sniff in models/api.py.
+
+    Returns int32[P, P], replicated: row r = estimated counts rank r
+    sends to each peer.
+    """
+    n = words[0].shape[0]
+    s = min(n, max(64, 32 * n_ranks))
+    if s > 1:
+        stride = -(-(n - 1) // (s - 1))     # ceil: picks stay <= s
+        s = (n - 1) // stride + 1
+        start = (n - 1) - (s - 1) * stride  # last pick = n-1
+    else:
+        stride, start = 1, 0
+    with spans.maybe_span("negotiate_probe", algorithm="sample",
+                          ranks=n_ranks, n=n, trace_time=True):
+        samp = tuple(
+            lax.slice(w, (start,), (start + (s - 1) * stride + 1,),
+                      (stride,))
+            for w in words
+        )
+        splitters = select_splitters(kernels.local_sort(samp), n_ranks,
+                                     min(oversample, s), axis)
+        dest = kernels.searchsorted_words(splitters, words)
+        h = kernels.histogram(dest, n_ranks)
+        return coll.all_gather(h, axis)
+
+
 def sample_sort_spmd(
     words: Words,
     n_words: int,

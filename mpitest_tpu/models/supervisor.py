@@ -206,6 +206,7 @@ class SortSupervisor:
                       round_cap: Callable[[int, int], int],
                       cap_limit: int | None = None,
                       on_overflow: Callable[[], None] | None = None,
+                      re_stage: Callable[[], None] | None = None,
                       ) -> tuple[object, int]:
         """Run ``attempt(cap) -> (payload, max_cnt)`` until the exchange
         fits; grow the cap to the reported need otherwise.  The cap only
@@ -213,7 +214,14 @@ class SortSupervisor:
         ``cap_limit``: raise :class:`ExchangeCapExceeded` when the need
         crosses it (the sample path's O(n) recv-memory bound).
         ``on_overflow``: invalidate donated input words before any
-        rerun."""
+        rerun.  ``re_stage``: skew-aware rebalance hook (ISSUE 7) —
+        invoked ONCE when the loop detects *persistent* imbalance (a
+        second overflow regrow means the input arrangement, not a
+        one-off estimate, is driving the cap); the callback interleaves
+        the shards so per-peer counts collapse toward the fair share,
+        and the already-grown cap is guaranteed to fit the rebalanced
+        exchange."""
+        regrows = 0
         while True:
             payload, max_cnt = attempt(cap)
             if max_cnt <= cap:
@@ -223,6 +231,13 @@ class SortSupervisor:
                 on_overflow()
             if cap_limit is not None and need > cap_limit:
                 raise ExchangeCapExceeded(max_cnt, cap_limit)
+            regrows += 1
+            if re_stage is not None and regrows >= 2:
+                self.tracer.verbose(
+                    f"{label} exchange overflowed {regrows} times "
+                    "(persistent imbalance); re-staging shards")
+                re_stage()
+                re_stage = None  # once per run
             self.tracer.verbose(
                 f"{label} exchange overflow (need {max_cnt} > cap {cap}); "
                 "retrying")

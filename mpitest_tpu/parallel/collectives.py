@@ -80,6 +80,39 @@ def exclusive_cumsum(x: jax.Array, axis: int = 0) -> jax.Array:
     return c - x  # exclusive
 
 
+def block_send_counts(H: jax.Array, n: int, axis: str = AXIS) -> jax.Array:
+    """MY per-destination-block send counts, from the replicated histogram
+    alone — the cheap pre-exchange behind capacity negotiation (ISSUE 7).
+
+    Under the "destination = exact global position" repartition (the
+    radix pass contract, models/radix_sort.py), my keys of digit ``d``
+    occupy global positions ``[base[d], base[d] + H[me, d])`` where
+    ``base[d] = digit_base[d] + rank_base[me, d]``.  The number of my
+    keys landing in destination block s — ``[s·n, (s+1)·n)`` — is then a
+    sum of clipped interval intersections over digits: pure arithmetic
+    on the ``[P, bins]`` ``H`` matrix every rank already holds after the
+    tiny histogram ``all_gather``.  No key moves; the full per-peer
+    requirement of the upcoming ragged exchange is known *before* any
+    ``[P, cap]`` buffer is allocated, so the host can compile with the
+    exact capacity instead of a worst-case guess.
+
+    Returns int32[P]: exact counts this rank will send to each peer
+    (self included — the self block never crosses a link but still
+    occupies exchange-buffer lanes).
+    """
+    me = lax.axis_index(axis)
+    n_ranks = H.shape[0]
+    tot = H.sum(axis=0)                          # [bins]
+    digit_base = exclusive_cumsum(tot)           # [bins]
+    rank_base = exclusive_cumsum(H, 0)           # [P, bins]
+    base = digit_base + rank_base[me]            # [bins] my global run starts
+    bounds = lax.iota(jnp.int32, n_ranks + 1) * n
+    # cum[s] = #{my keys with dest < s*n} = Σ_d clip(s*n - base[d], 0, H[me, d])
+    cum = jnp.clip(bounds[:, None] - base[None, :], 0, H[me][None, :]).sum(
+        axis=1)
+    return (cum[1:] - cum[:-1]).astype(jnp.int32)
+
+
 def exscan_counts(h: jax.Array, axis: str = AXIS) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Global exclusive scan of per-rank count vectors.
 

@@ -15,14 +15,37 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from mpitest_tpu.utils import knobs
+
 AXIS = "x"  # the single key axis; all sharding is 1-D over it
+
+
+def _device_order_key(d: "jax.Device") -> tuple:
+    """Stable total order over devices: (process, device id).  ``id`` is
+    the runtime's stable per-process ordinal (derived from topology
+    coords on TPU), so the same hardware always maps to the same mesh
+    position regardless of enumeration order."""
+    return (getattr(d, "process_index", 0), getattr(d, "id", 0))
 
 
 def make_mesh(n_devices: int | None = None,
               devices: "list[jax.Device] | None" = None) -> Mesh:
-    """Build the 1-D mesh over all (or the first ``n_devices``) devices."""
+    """Build the 1-D mesh over all (or the first ``n_devices``) devices.
+
+    Device order is made deterministic HERE (sorted by stable device
+    id), never taken from enumeration order: the mesh position IS the
+    rank, so shard↔rank assignment — and therefore the exact output
+    bytes and fingerprints of a sharded run — must be reproducible
+    across restarts (ISSUE 7).  ``n_devices=None`` honors the
+    ``SORT_DEVICES`` knob (auto = all devices)."""
+    if n_devices is None and devices is None:
+        # the knob only fills the fully-default case: an explicitly
+        # passed device list (multihost local devices, tests) must
+        # never be silently truncated by ambient environment
+        n_devices = knobs.get("SORT_DEVICES")
     if devices is None:
         devices = jax.devices()
+    devices = sorted(devices, key=_device_order_key)
     if n_devices is not None:
         if n_devices > len(devices):
             raise ValueError(f"requested {n_devices} devices, have {len(devices)}")

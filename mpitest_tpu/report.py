@@ -37,13 +37,16 @@ from __future__ import annotations
 
 import argparse
 import json
+import re
 import sys
 from pathlib import Path
 
 from mpitest_tpu.utils import span_schema
-from mpitest_tpu.utils.span_schema import (FAULT_SPAN, INGEST_HOST_STAGES,
+from mpitest_tpu.utils.span_schema import (BALANCE_SPAN, FAULT_SPAN,
+                                           INGEST_HOST_STAGES,
                                            INGEST_XFER_STAGES, PHASE_PREFIX,
-                                           RETRY_SPAN, VERIFY_SPAN)
+                                           RESTAGE_SPAN, RETRY_SPAN,
+                                           VERIFY_SPAN)
 from mpitest_tpu.utils.spans import (MPI_EQUIV, SCHEMA as SPAN_SCHEMA,
                                      merge_intervals, overlap_seconds)
 
@@ -133,6 +136,11 @@ def aggregate(rows: list[dict]) -> dict:
     # into one table so a chaos run's telemetry is one `report` away.
     robust = {"faults": 0, "fault_sites": {}, "retries": 0,
               "verify_runs": 0, "verify_failures": 0}
+    # scale-out events (ISSUE 7): one exchange_balance event per
+    # negotiated exchange (per-rank send/recv bytes, negotiated vs
+    # worst-case capacity) + the restage count — the evidence row of
+    # the multi-chip path.
+    scaleout = {"balance": [], "restages": 0}
     # tooling state (ISSUE 4): bench rows stamp the lint/sanitizer gate
     # versions; the report surfaces the last-seen state so a table of
     # numbers names the rule set that guarded them.
@@ -175,6 +183,10 @@ def aggregate(rows: list[dict]) -> dict:
                     robust["fault_sites"].get(site, 0) + 1
             elif name == RETRY_SPAN:
                 robust["retries"] += 1
+            elif name == BALANCE_SPAN:
+                scaleout["balance"].append(obj.get("attrs", {}))
+            elif name == RESTAGE_SPAN:
+                scaleout["restages"] += 1
             elif name == VERIFY_SPAN:
                 robust["verify_runs"] += 1
                 if not obj.get("attrs", {}).get("ok", True):
@@ -235,10 +247,42 @@ def aggregate(rows: list[dict]) -> dict:
 
     return {"phases": phases, "collectives": colls, "metrics": metrics,
             "spans": span_counts, "ingest": ingest, "robustness": robust,
-            "tooling": tooling,
+            "scaleout": scaleout, "tooling": tooling,
             "encode_engines": sorted(encode_engines),
             "ingest_overlap": direction_overlap("ingest"),
             "egress_overlap": direction_overlap("egress")}
+
+
+# -------------------------------------------------------------- scale-out
+
+#: Bench metric-name shape of a sorted-throughput row; the ``_8dev``
+#: suffix marks the devices=8 scale-out row (bench.py ISSUE 7).
+_THROUGHPUT_RE = re.compile(
+    r"^(radix|sample)_sort_mkeys_per_s_2e(\d+)_([a-z0-9]+?)(_8dev)?$")
+
+
+def scaleout_throughput(metrics: dict) -> list[dict]:
+    """Pair the 1-device and devices=8 throughput rows by (algo, dtype)
+    for the scale-out table: each entry carries both values (where
+    present) and their ratio when the scales match — comparing rows at
+    different N would manufacture a fake speedup, so mismatched scales
+    report the values but no ratio."""
+    base: dict[tuple, dict] = {}
+    multi: dict[tuple, dict] = {}
+    for name, m in metrics.items():
+        mt = _THROUGHPUT_RE.match(name)
+        if not mt or m.get("value") is None:
+            continue
+        row = {"log2n": int(mt.group(2)), "value": float(m["value"])}
+        (multi if mt.group(4) else base)[(mt.group(1), mt.group(3))] = row
+    out = []
+    for key in sorted(set(base) | set(multi)):
+        b, p8 = base.get(key), multi.get(key)
+        entry: dict = {"algo": key[0], "dtype": key[1], "p1": b, "p8": p8}
+        if b and p8 and b["log2n"] == p8["log2n"] and b["value"] > 0:
+            entry["speedup"] = round(p8["value"] / b["value"], 3)
+        out.append(entry)
+    return out
 
 
 # ------------------------------------------------------------ regression
@@ -266,6 +310,17 @@ def flag_regressions(current: dict, baseline_rows: list[dict],
         if cur is None or cur.get("value") is None:
             findings.append({"metric": name, "status": "missing",
                              "reason": "no current row for pinned metric"})
+            continue
+        # devices provenance (ISSUE 7): a row pinned at devices=8 only
+        # gates a devices=8 measurement — a 1-device run "regressing"
+        # against an 8-chip pin is a topology difference, not a
+        # regression (and vice versa).
+        row_dev = row.get("devices")
+        if row_dev is not None and cur.get("devices") != row_dev:
+            findings.append({"metric": name, "status": "skipped",
+                             "reason": f"devices mismatch (pinned at "
+                                       f"devices={row_dev}, current="
+                                       f"{cur.get('devices')})"})
             continue
         val = float(cur["value"])
         if pinned > 0 and val < threshold * pinned:
@@ -387,6 +442,34 @@ def render(agg: dict) -> str:
             if m and m.get("value") is not None:
                 unit = m.get("unit") or ""
                 out.append(f"  {label}: {m['value']} {unit}".rstrip())
+    so = agg.get("scaleout") or {}
+    pairs = scaleout_throughput(agg["metrics"])
+    if so.get("balance") or so.get("restages") or any(
+            p.get("p8") for p in pairs):
+        out.append("")
+        out.append("scale-out (negotiated exchange + P=1 vs P=8)")
+        for b in so.get("balance", []):
+            neg, worst = b.get("negotiated_cap"), b.get("worst_cap")
+            saving = (f" ({100.0 * (1 - neg / worst):.1f}% below worst-case "
+                      f"{worst})" if neg and worst else "")
+            out.append(
+                f"  {b.get('algorithm', '?'):<7} ranks={b.get('ranks', '?')}"
+                f" negotiated cap {neg}{saving}; recv max/mean "
+                f"{b.get('recv_ratio')}x, peer/fair {b.get('peer_ratio')}x"
+                + (" [re-staged]" if b.get("restaged") else "")
+                + ("" if b.get("exact") else " [estimate]"))
+        for p in pairs:
+            if not p.get("p8"):
+                continue
+            p1 = (f"P=1 {p['p1']['value']} Mkeys/s (2^{p['p1']['log2n']})"
+                  if p.get("p1") else "P=1 (no row)")
+            line = (f"  throughput {p['algo']}/{p['dtype']}: {p1} vs "
+                    f"P=8 {p['p8']['value']} Mkeys/s (2^{p['p8']['log2n']})")
+            if "speedup" in p:
+                line += f" -> {p['speedup']}x"
+            out.append(line)
+        if so.get("restages"):
+            out.append(f"  skew re-stages: {so['restages']}")
     rb = agg.get("robustness") or {}
     if any(rb.get(k) for k in ("faults", "retries", "verify_runs")):
         out.append("")

@@ -308,6 +308,50 @@ register("SORT_LOCAL_ENGINE", "enum", "auto", "auto | bitonic | lax",
          "Local (single-device) sort engine; auto = bitonic on TPU.",
          _enum("SORT_LOCAL_ENGINE", ("auto", "bitonic", "lax")))
 
+
+def _parse_devices(raw: str) -> int | None:
+    if raw == "auto":
+        return None
+    try:
+        v = int(raw)
+    except ValueError:
+        v = 0
+    if v < 1:
+        raise KnobError(f"SORT_DEVICES={raw!r}: use 'auto' or an "
+                        "integer >= 1") from None
+    return v
+
+
+# Scale-out knobs (ISSUE 7): the P-device sharded path is the primary
+# path, so the device count, the capacity negotiation and the skew
+# re-stage are all first-class, registered knobs.
+
+register("SORT_DEVICES", "int", None, "'auto' or an integer >= 1",
+         "Mesh device count when none is passed explicitly (auto: all).",
+         _parse_devices)
+register("SORT_NEGOTIATE", "enum", "auto", "auto | on | off",
+         "Exchange-capacity negotiation from a count probe (auto: P>1).",
+         _enum("SORT_NEGOTIATE", ("auto", "on", "off")))
+register("SORT_RESTAGE", "enum", "auto", "auto | off",
+         "Skew-aware re-stage (shard interleave) on exchange imbalance.",
+         _enum("SORT_RESTAGE", ("auto", "off")))
+
+
+def _parse_restage_ratio(raw: str) -> float:
+    try:
+        v = float(raw)
+    except ValueError:
+        v = 0.0
+    if not math.isfinite(v) or v <= 1.0:
+        raise KnobError(f"SORT_RESTAGE_RATIO={raw!r}: use a finite "
+                        "number > 1")
+    return v
+
+
+register("SORT_RESTAGE_RATIO", "float", 4.0, "a finite number > 1",
+         "Per-peer max/fair-share count ratio that triggers a re-stage.",
+         _parse_restage_ratio)
+
 # Observability sidecar paths (off when unset — the byte-compatible CLI
 # contract is untouched by default).
 register("SORT_TRACE", "path", None, "a writable file path",
@@ -418,6 +462,9 @@ register("BENCH_NATIVE_RANKS", "int", 8, "an integer >= 0 (0 disables)",
 register("BENCH_NATIVE_REPEATS", "int", 3, "an integer >= 1",
          "Native denominator runs; the median is the denominator.",
          _int("BENCH_NATIVE_REPEATS", lo=1))
+register("BENCH_MULTICHIP", "enum", "auto", "auto | off",
+         "Emit the devices=8 bench row (real mesh, else cpu:8 fallback).",
+         _enum("BENCH_MULTICHIP", ("auto", "off")))
 
 # Bench-script knobs (bench/*.py probes and batteries).
 

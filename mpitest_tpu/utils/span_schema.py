@@ -58,6 +58,13 @@ SPAN_NAMES: dict[str, str] = {
     "fault": "one injected fault firing (site, seq)",
     "supervisor_retry": "one retried SPMD dispatch (label, attempt, error)",
     "verify": "one output verification (ok, sorted_ok, fp_ok)",
+    # scale-out vocabulary (ISSUE 7)
+    "exchange_balance": ("negotiated exchange capacity + per-rank "
+                         "send/recv byte balance (host count probe)"),
+    "restage": "skew-aware re-stage (shard interleave) of the input words",
+    "negotiate_probe": ("one capacity-negotiation count probe "
+                        "(trace-time; its collectives nest here, "
+                        "not under a pass)"),
     # models/ingest.py — streamed pipeline stages (ISSUE 2)
     "ingest.parse": "parse/materialize one host chunk",
     "ingest.encode": "codec-encode one chunk (worker pool)",
@@ -76,6 +83,10 @@ INGEST_XFER_STAGES = ("ingest.transfer", "egress.fetch")
 FAULT_SPAN = "fault"
 RETRY_SPAN = "supervisor_retry"
 VERIFY_SPAN = "verify"
+
+#: Scale-out event names the report's scale-out table folds (ISSUE 7).
+BALANCE_SPAN = "exchange_balance"
+RESTAGE_SPAN = "restage"
 
 
 def is_registered(name: str) -> bool:

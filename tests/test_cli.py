@@ -133,11 +133,14 @@ def test_metrics_sidecar_env(keyfile, capsys, monkeypatch, tmp_path):
 
 def test_cap_factor_oversample_knobs(keyfile, capsys, monkeypatch, tmp_path):
     """SORT_CAP_FACTOR / SORT_OVERSAMPLE reach the sort (visible in the
-    metrics sidecar's exchange_cap) and keep the contract intact."""
+    metrics sidecar's exchange_cap) and keep the contract intact.
+    Negotiation pinned off: with it on, the cap comes from the measured
+    count probe and cap_factor is (by design, ISSUE 7) not the driver."""
     import json
 
     path, keys = keyfile
     sidecar = tmp_path / "m.jsonl"
+    monkeypatch.setenv("SORT_NEGOTIATE", "off")
     monkeypatch.setenv("SORT_ALGO", "sample")
     monkeypatch.setenv("SORT_METRICS", str(sidecar))
     monkeypatch.setenv("SORT_CAP_FACTOR", "6.0")

@@ -469,7 +469,9 @@ def test_ingest_dtype_guard(mesh8):
 def test_donated_dispatch_with_overflow_retry(mesh8, rng, monkeypatch):
     """SORT_DONATE=1: the sort donates the staged word buffers to the
     SPMD program; an exchange-overflow retry must re-stage the input
-    (the donated buffers are dead) and still produce exact bytes."""
+    (the donated buffers are dead) and still produce exact bytes.
+    Negotiation pinned off: see test_forced_tiny_cap_overflow_retry."""
+    monkeypatch.setenv("SORT_NEGOTIATE", "off")
     monkeypatch.setenv("SORT_DONATE", "1")
     monkeypatch.setenv("SORT_INGEST", "stream")
     monkeypatch.setenv("SORT_INGEST_CHUNK", "4096")
@@ -516,7 +518,10 @@ def test_forced_tiny_cap_overflow_retry(algo, donate, mesh8, rng,
     path (now the supervisor's ONE shared cap-regrow loop) must recover
     exact bytes, with and without buffer donation (the donated variant
     exercises the PR 2 re-stage path: the failed dispatch consumed the
-    input words)."""
+    input words).  Capacity negotiation (ISSUE 7) is pinned OFF: it
+    sizes the cap from the count probe precisely so this overflow never
+    happens — these tests exercise the backstop loop it backstops."""
+    monkeypatch.setenv("SORT_NEGOTIATE", "off")
     monkeypatch.setenv("SORT_DONATE", donate)
     from mpitest_tpu.utils.trace import Tracer
 
@@ -534,7 +539,9 @@ def test_forced_tiny_cap_overflow_retry(algo, donate, mesh8, rng,
 def test_tiny_cap_retry_with_staged_donated_ingest(mesh8, rng, monkeypatch):
     """Tiny cap + donation + streamed StagedIngest input: the overflow
     retry must re-stream from the staged source (PR 2's donated-buffer
-    re-stage) and still verify."""
+    re-stage) and still verify.  Negotiation pinned off: see
+    test_forced_tiny_cap_overflow_retry."""
+    monkeypatch.setenv("SORT_NEGOTIATE", "off")
     monkeypatch.setenv("SORT_DONATE", "1")
     monkeypatch.setenv("SORT_INGEST", "stream")
     monkeypatch.setenv("SORT_INGEST_CHUNK", "8192")

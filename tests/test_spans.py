@@ -125,7 +125,10 @@ def test_radix_run_span_contract(radix_traced):
     assert len(a2a) == len(passes)  # one exchange per pass
     for s in a2a:
         assert s.attrs["ranks"] == 8 and s.attrs["wire_bytes"] > 0
-    # every collective nests under a pass span; passes under the jit span
+    # every collective nests under a pass span; passes under the jit
+    # span.  Capacity-negotiation probe collectives (ISSUE 7) are the
+    # registered exception: they nest under negotiate_probe, which has
+    # no pass (the probe runs before any pass exists).
     byid = {s.id: s for s in sp}
     for c in colls:
         chain = []
@@ -133,7 +136,10 @@ def test_radix_run_span_contract(radix_traced):
         while p is not None:
             chain.append(byid[p].name)
             p = byid[p].parent
-        assert "radix_pass" in chain and "sort" in chain
+        assert "sort" in chain
+        if "negotiate_probe" in chain:
+            continue
+        assert "radix_pass" in chain
     # the totals aggregate on the shared comm.h vocabulary
     totals = radix_traced.spans.collective_totals()
     assert totals["alltoallv"]["calls"] == len(a2a)

@@ -1,0 +1,237 @@
+"""Scale-out tests (ISSUE 7): P=1 vs P=8 bit-parity, capacity
+negotiation, the skew re-stage, deterministic mesh order and the
+SORT_DEVICES knob.
+
+Named ``test_zz_*`` to sort LATE in the tier-1 run — the suite is
+timeout-bound and these tests pay fresh shard_map compiles.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+
+from mpitest_tpu.models import api
+from mpitest_tpu.models.api import sort
+from mpitest_tpu.models.supervisor import SortSupervisor
+from mpitest_tpu.ops.keys import codec_for
+from mpitest_tpu.parallel.mesh import make_mesh
+from mpitest_tpu.utils import knobs
+from mpitest_tpu.utils.trace import Tracer
+
+ALGOS = ("radix", "sample")
+
+
+@pytest.fixture(scope="module")
+def mesh1():
+    return make_mesh(1)
+
+
+def _keys(dtype, n, rng):
+    dtype = np.dtype(dtype)
+    if dtype.kind == "f":
+        return (rng.random(n) * 1e6 - 5e5).astype(dtype)
+    info = np.iinfo(dtype)
+    return rng.integers(info.min, info.max, size=n, dtype=dtype,
+                        endpoint=False)
+
+
+# ---------------------------------------------------- 1-vs-8 bit parity
+
+@pytest.mark.parametrize("algo", ALGOS)
+@pytest.mark.parametrize("dtype", [np.int32, np.uint64, np.float32])
+def test_parity_1_vs_8_bitwise(algo, dtype, mesh8, mesh1, rng):
+    """The sharded sort's output is canonical: 8 devices and 1 device
+    must produce the same BYTES, not just the same values."""
+    x = _keys(dtype, 2048, rng)
+    out8 = sort(x, algorithm=algo, mesh=mesh8)
+    out1 = sort(x, algorithm=algo, mesh=mesh1)
+    assert out8.dtype == out1.dtype == np.dtype(dtype)
+    assert out8.tobytes() == out1.tobytes()
+
+
+@pytest.mark.parametrize("algo", ALGOS)
+@pytest.mark.parametrize("n", [3, 1001])
+def test_parity_awkward_n(algo, n, mesh8, mesh1, rng):
+    """N < P and P∤N — the padding/slicing contract must hold at any
+    mesh size (the reference gets exactly this wrong)."""
+    x = rng.integers(-2**31, 2**31 - 1, size=n, dtype=np.int32)
+    out8 = sort(x, algorithm=algo, mesh=mesh8)
+    out1 = sort(x, algorithm=algo, mesh=mesh1)
+    assert out8.tobytes() == out1.tobytes()
+
+
+# ------------------------------------- negotiation + re-stage behavior
+
+def test_negotiation_sizes_cap_exactly_on_skew(mesh8, rng):
+    """Single-pass radix on a sorted (clustered) input: the probe must
+    re-stage, negotiate a cap strictly below the worst case, and finish
+    with ZERO overflow retries even under a degenerate cap_factor."""
+    x = np.sort(rng.integers(0, 1 << 16, size=1 << 13).astype(np.int32))
+    t = Tracer()
+    out = sort(x, algorithm="radix", mesh=mesh8, digit_bits=16,
+               cap_factor=1e-9, tracer=t)
+    assert np.array_equal(out, x)
+    c = t.counters
+    assert c.get("skew_restage") == 1
+    assert c["negotiated_cap"] < c["worst_cap"]
+    assert c.get("exchange_retries", 0) == 0
+    # post-re-stage the exchange is balanced
+    assert c["exchange_peer_ratio"] < 2.0
+    assert c["exchange_balance_ratio"] < 2.0
+
+
+def test_regrow_loop_still_carries_negotiation_off(mesh8, rng,
+                                                   monkeypatch):
+    """With SORT_NEGOTIATE=off the pre-ISSUE-7 behavior is intact: the
+    squeezed cap overflows, the regrow loop recovers, output exact."""
+    monkeypatch.setenv("SORT_NEGOTIATE", "off")
+    x = np.sort(rng.integers(0, 1 << 16, size=1 << 13).astype(np.int32))
+    t = Tracer()
+    out = sort(x, algorithm="radix", mesh=mesh8, digit_bits=16,
+               cap_factor=1e-9, tracer=t)
+    assert np.array_equal(out, x)
+    assert t.counters.get("exchange_retries", 0) >= 1
+    assert "negotiated_cap" not in t.counters
+
+
+def test_restage_off_keeps_worst_case_cap(mesh8, rng, monkeypatch):
+    """SORT_RESTAGE=off: negotiation still sizes the cap (no overflow
+    retries), but the clustered arrangement keeps its near-worst-case
+    per-peer need — the saving the re-stage exists to claw back."""
+    monkeypatch.setenv("SORT_RESTAGE", "off")
+    x = np.sort(rng.integers(0, 1 << 16, size=1 << 13).astype(np.int32))
+    t = Tracer()
+    out = sort(x, algorithm="radix", mesh=mesh8, digit_bits=16, tracer=t)
+    assert np.array_equal(out, x)
+    c = t.counters
+    assert "skew_restage" not in c
+    assert c.get("exchange_retries", 0) == 0
+    assert c["exchange_peer_ratio"] > 4.0  # diag-heavy: ~P x fair share
+
+
+def test_exchange_balance_event_schema(mesh8, rng):
+    """The exchange_balance event carries per-rank send/recv byte lists
+    (one entry per rank) and the negotiated/worst caps."""
+    x = rng.integers(-2**31, 2**31 - 1, size=1 << 12, dtype=np.int32)
+    t = Tracer()
+    sort(x, algorithm="radix", mesh=mesh8, tracer=t)
+    ev = [s for s in t.spans.spans if s.name == "exchange_balance"]
+    assert len(ev) == 1
+    a = ev[0].attrs
+    assert len(a["send_bytes"]) == 8 and len(a["recv_bytes"]) == 8
+    assert a["negotiated_cap"] <= a["worst_cap"]
+    assert a["exact"] is True  # the radix probe is exact
+
+
+def test_supervisor_reactive_restage_once():
+    """exchange_loop invokes re_stage exactly once, at the second
+    overflow (persistent imbalance), never on the first."""
+    calls: list[int] = []
+
+    def attempt(c):
+        # overflows until the re-stage lands, then fits
+        return ("ok", c) if calls else ("overflow", c + 1)
+
+    def re_stage():
+        calls.append(1)
+
+    sup = SortSupervisor(Tracer())
+    payload, cap = sup.exchange_loop(
+        "t", attempt, 4, 1, lambda v, a: v, re_stage=re_stage)
+    assert payload == "ok" and calls == [1]
+    assert sup.tracer.counters["exchange_retries"] == 2
+
+
+def test_radix_probe_counts_exact(mesh8, rng):
+    """Probe invariants: every rank sends all n keys (row sums = n) and
+    — radix being receive-balanced by construction — every rank also
+    receives exactly n (column sums = n)."""
+    x = rng.integers(-2**31, 2**31 - 1, size=1 << 12, dtype=np.int32)
+    codec = codec_for(np.dtype(np.int32))
+    n = x.size // 8
+    words = api._shard_input(codec.encode(x), mesh8, n)
+    cnts = np.asarray(
+        api._compile_radix_probe(mesh8, 1, n, 8)(*words))
+    assert cnts.shape == (8, 8)
+    assert (cnts.sum(axis=1) == n).all()
+    assert (cnts.sum(axis=0) == n).all()
+
+
+# ------------------------------- mesh determinism + SORT_DEVICES knob
+
+def test_make_mesh_order_deterministic():
+    """Shard↔rank assignment must not depend on enumeration order:
+    a shuffled device list yields the same mesh as the sorted one."""
+    devs = list(jax.devices())
+    ids = [d.id for d in make_mesh(devices=list(reversed(devs))).devices.flat]
+    assert ids == sorted(d.id for d in devs)
+    assert ids == [d.id for d in make_mesh(devices=devs).devices.flat]
+
+
+def test_sort_devices_knob():
+    with knobs.scoped_env(SORT_DEVICES="4"):
+        assert make_mesh().devices.size == 4
+    with knobs.scoped_env(SORT_DEVICES="auto"):
+        assert make_mesh().devices.size == len(jax.devices())
+    with knobs.scoped_env(SORT_DEVICES=None):
+        assert make_mesh().devices.size == len(jax.devices())
+    with knobs.scoped_env(SORT_DEVICES=str(len(jax.devices()) + 1)):
+        with pytest.raises(ValueError, match="requested"):
+            make_mesh()
+    for bad in ("0", "-1", "garbage"):
+        with knobs.scoped_env(SORT_DEVICES=bad):
+            with pytest.raises(ValueError, match="SORT_DEVICES"):
+                knobs.get("SORT_DEVICES")
+
+
+def test_scaleout_knob_validation():
+    with knobs.scoped_env(SORT_NEGOTIATE="maybe"):
+        with pytest.raises(ValueError, match="SORT_NEGOTIATE"):
+            knobs.get("SORT_NEGOTIATE")
+    with knobs.scoped_env(SORT_RESTAGE_RATIO="1.0"):
+        with pytest.raises(ValueError, match="SORT_RESTAGE_RATIO"):
+            knobs.get("SORT_RESTAGE_RATIO")
+    with knobs.scoped_env(SORT_RESTAGE_RATIO="2.5"):
+        assert knobs.get("SORT_RESTAGE_RATIO") == 2.5
+
+
+# -------------------------------------------- report scale-out surface
+
+def test_report_scaleout_pairs():
+    from mpitest_tpu.report import scaleout_throughput
+
+    metrics = {
+        "radix_sort_mkeys_per_s_2e20_int32": {"value": 100.0},
+        "radix_sort_mkeys_per_s_2e20_int32_8dev": {"value": 400.0,
+                                                   "devices": 8},
+        "sample_sort_mkeys_per_s_2e18_int32": {"value": 50.0},
+        "sample_sort_mkeys_per_s_2e20_int32_8dev": {"value": 90.0,
+                                                    "devices": 8},
+    }
+    pairs = {(p["algo"], p["dtype"]): p
+             for p in scaleout_throughput(metrics)}
+    assert pairs[("radix", "int32")]["speedup"] == 4.0
+    # mismatched N: both rows surface, but no fabricated ratio
+    assert "speedup" not in pairs[("sample", "int32")]
+
+
+def test_report_baseline_devices_gate():
+    from mpitest_tpu.report import flag_regressions
+
+    current = {"metrics": {
+        "radix_sort_mkeys_per_s_2e20_int32_8dev":
+            {"value": 10.0, "devices": 1},
+    }}
+    baseline = [{"kind": "bench",
+                 "metric": "radix_sort_mkeys_per_s_2e20_int32_8dev",
+                 "value": 100.0, "devices": 8}]
+    findings = flag_regressions(current, baseline, 0.9, host="h")
+    assert findings[0]["status"] == "skipped"
+    assert "devices mismatch" in findings[0]["reason"]
+    # matching devices: compared normally (and here, regressing)
+    current["metrics"][
+        "radix_sort_mkeys_per_s_2e20_int32_8dev"]["devices"] = 8
+    findings = flag_regressions(current, baseline, 0.9, host="h")
+    assert findings[0]["status"] == "REGRESSION"

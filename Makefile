@@ -40,6 +40,19 @@
 #                  per-rank exchange-byte imbalance under the gate, and
 #                  negotiated capacity strictly below the worst-case
 #                  cap with zero overflow retries on skewed inputs
+#   make serve-selftest — the sort-as-a-service gate (ISSUE 8): spins
+#                  drivers/sort_server.py in subprocesses and drives
+#                  bench/serve_load.py's closed-loop small-request mix
+#                  against them.  Asserts: warm-cache requests record
+#                  ZERO compile spans (the AOT executor cache), batched
+#                  multi-tenant dispatch is bit-identical to
+#                  per-request sorts AND >= 2x their dispatch
+#                  throughput, backpressure rejections and injected
+#                  per-request faults come back as TYPED errors while
+#                  the server keeps serving, and SIGTERM drains
+#                  gracefully.  The server span stream then passes
+#                  `report.py --check --require-registered-spans` and
+#                  renders the p50/p99 SLO table.
 #   make lint    — static analysis (ISSUE 4): sortlint (the project's
 #                  custom AST rules — env-knob registry, span schema,
 #                  SPMD safety, fault coverage, typed core), the
@@ -64,8 +77,9 @@
 PYTHON ?= python3
 
 .PHONY: test native native-encode chip-test telemetry-selftest \
-    ingest-selftest fault-selftest multichip-selftest lint cwarn-check \
-    typecheck tidy-check knob-docs sanitize-selftest clean
+    ingest-selftest fault-selftest multichip-selftest serve-selftest \
+    lint cwarn-check typecheck tidy-check knob-docs sanitize-selftest \
+    clean
 
 chip-test:
 	$(PYTHON) -u bench/chip_regression.py
@@ -122,6 +136,22 @@ multichip-selftest:
 	JAX_PLATFORMS=cpu \
 	    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 	    $(PYTHON) -u bench/multichip_selftest.py
+
+# The sort-as-a-service gate (ISSUE 8) — see bench/serve_load.py.
+# Servers are spawned as subprocesses on a plain 1-device CPU backend
+# (the fault leg forces its own 2-device virtual mesh); the final
+# report passes validate the server's span stream against the
+# registered schema and render the p50/p99 SLO table from it.
+SERVE_TMP := /tmp/mpitest_serve_selftest
+serve-selftest:
+	rm -rf $(SERVE_TMP) && mkdir -p $(SERVE_TMP)
+	JAX_PLATFORMS=cpu \
+	    SORT_METRICS=$(SERVE_TMP)/metrics.jsonl \
+	    $(PYTHON) -u bench/serve_load.py --selftest --out $(SERVE_TMP)
+	$(PYTHON) -m mpitest_tpu.report --check --require-registered-spans \
+	    $(SERVE_TMP)/server_trace_batched.jsonl
+	$(PYTHON) -m mpitest_tpu.report \
+	    $(SERVE_TMP)/server_trace_batched.jsonl $(SERVE_TMP)/metrics.jsonl
 
 # Proof the streamed ingest pipeline is live, overlapping, and fast
 # (ISSUE 6): the NATIVE encode engine is built and FORCED ON for every

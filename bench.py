@@ -258,6 +258,52 @@ def _emit_multichip_row(log2n: int, algo: str, dtype: np.dtype,
         log(f"multichip: skipped ({type(e).__name__}: {e})")
 
 
+def _emit_serve_row() -> None:
+    """Third JSONL row (ISSUE 8): the sort-as-a-service measurement —
+    ``bench/serve_load.py --row`` spawns a server subprocess, drives the
+    small-request mix closed-loop, and emits the p50/p99 + Mkeys/s row
+    beside the 1-chip and devices=8 rows.  Best-effort by contract: any
+    failure logs and skips, never costs the other rows.  The load
+    generator runs in its own process GROUP: a timeout kill must take
+    its spawned sort_server grandchildren with it (a SIGKILLed
+    serve_load never reaches its own cleanup, and an orphaned server
+    would hold a JAX runtime forever)."""
+    import signal
+
+    proc = None
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, str(REPO / "bench" / "serve_load.py"),
+             "--row", "--out",
+             str(REPO / "bench" / ".serve-row")],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            start_new_session=True)
+        try:
+            out, err = proc.communicate(timeout=900)
+        except subprocess.TimeoutExpired:
+            os.killpg(proc.pid, signal.SIGKILL)
+            proc.wait(timeout=10)
+            log("serve: row run timed out (process group killed); "
+                "omitting row")
+            return
+        for line in err.splitlines():
+            log(f"serve| {line}")
+        rows = [ln for ln in out.splitlines() if ln.strip()]
+        if proc.returncode != 0 or not rows:
+            log(f"serve: row run failed (rc={proc.returncode}); "
+                "omitting row")
+            return
+        row = json.loads(rows[-1])  # re-validate before re-emitting
+        print(json.dumps(row))
+    except Exception as e:  # noqa: BLE001 — the row is best-effort
+        log(f"serve: skipped ({type(e).__name__}: {e})")
+        if proc is not None and proc.poll() is None:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except OSError:
+                pass
+
+
 def multichip_main() -> None:
     """``bench.py --multichip-row``: measure ONLY the devices=8 row (the
     subprocess side of :func:`_emit_multichip_row`)."""
@@ -682,6 +728,20 @@ def main() -> None:
     # untouched so the r01+ trajectory stays comparable.
     if knobs.get("BENCH_MULTICHIP") != "off":
         _emit_multichip_row(log2n, algo, dtype, repeats, mkeys, platform)
+
+    # Third JSONL row (ISSUE 8): the sort-as-a-service headline — the
+    # persistent server under the closed-loop small-request mix
+    # (bench/serve_load.py), p50/p99 latency + throughput.  Scale-gated
+    # like the multichip row: tiny-scale runs are driver-contract
+    # smoke tests (and several tests scrape stdout's last line as the
+    # primary row), so only measured-scale benches pay the ~minute of
+    # server spawns.
+    if knobs.get("BENCH_SERVE") != "off":
+        if log2n >= 16:
+            _emit_serve_row()
+        else:
+            log(f"serve: skipped at 2^{log2n} (scale-gated like the "
+                "multichip row; run bench/serve_load.py --row directly)")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,129 @@
+#!/usr/bin/env python3
+"""Sort-as-a-service entry point: the persistent server (ISSUE 8).
+
+Where ``sort_cli.py`` is the reference's one-shot batch contract, this
+driver is the production shape the ROADMAP's north star actually needs:
+a long-lived process that compiles once (AOT executor cache with
+power-of-two shape bucketing), bounds its queue (typed backpressure),
+packs concurrent small requests into one segmented device dispatch
+(multi-tenant batching), and supervises every request so a poisoned
+input yields a typed per-request error — never server death.
+
+Usage::
+
+    python drivers/sort_server.py            # knobs configure everything
+
+Knobs (all validated fail-fast — garbage is one ``[ERROR]`` line and
+exit 1, never a traceback): ``SORT_SERVE_PORT`` (0 = ephemeral; the
+bound port is printed either way), ``SORT_SERVE_HOST``,
+``SORT_SERVE_MAX_INFLIGHT`` / ``SORT_SERVE_MAX_BYTES`` (admission),
+``SORT_SERVE_BATCH_WINDOW_MS`` / ``SORT_SERVE_BATCH_KEYS`` (batching),
+``SORT_SERVE_SHAPE_BUCKETS`` / ``SORT_SERVE_PREWARM`` (executor cache),
+``SORT_SERVE_ALLOW_FAULTS`` (test mode), plus every ordinary sort knob
+(``SORT_ALGO``, ``SORT_DEVICES``, ``SORT_VERIFY``, ...).
+
+Startup prints exactly one ``sort_server listening on HOST:PORT`` line
+to stdout (flushed) once the socket accepts — load generators and the
+selftest synchronize on it.  ``SIGTERM``/``SIGINT`` drain gracefully:
+in-flight requests complete, new work gets a typed ``draining``
+rejection, then the process exits 0.
+
+Telemetry: ``SORT_TRACE=<path>`` streams every ``serve.request`` /
+``serve.batch`` / ``serve.compile_cache`` span (plus all the ordinary
+sort spans) as JSONL; ``python -m mpitest_tpu.report`` renders the
+p50/p99 SLO table from exactly that stream.
+"""
+
+from __future__ import annotations
+
+import signal
+import sys
+import threading
+from pathlib import Path
+
+# Script-invocation bootstrap: the repo root (not drivers/) holds the
+# package, and this image cannot `pip install -e .`.
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv if argv is None else argv
+    if len(argv) != 1:
+        print(f"Usage: {argv[0]}  (configuration rides the SORT_SERVE_* "
+              "environment knobs)", file=sys.stderr)
+        return 1
+
+    from mpitest_tpu.utils import knobs
+
+    def err(msg: str) -> None:
+        print(f"[ERROR] {msg}", file=sys.stderr)
+
+    # Fail-fast knob validation — the CLI contract: a garbage knob is
+    # one clean [ERROR] line naming the knob, before any JAX work.
+    try:
+        host = knobs.get("SORT_SERVE_HOST")
+        port = knobs.get("SORT_SERVE_PORT")
+        knobs.validate(
+            "SORT_SERVE_MAX_INFLIGHT", "SORT_SERVE_MAX_BYTES",
+            "SORT_SERVE_BATCH_WINDOW_MS", "SORT_SERVE_BATCH_KEYS",
+            "SORT_SERVE_SHAPE_BUCKETS", "SORT_SERVE_PREWARM",
+            "SORT_SERVE_ALLOW_FAULTS",
+            # the sort knobs every dispatch consumes
+            "SORT_ALGO", "SORT_DTYPE", "SORT_DEVICES", "SORT_RANKS",
+            "SORT_VERIFY", "SORT_MAX_RETRIES", "SORT_RETRY_BACKOFF",
+            "SORT_FALLBACK", "SORT_FAULTS", "SORT_FAULTS_SEED",
+            "SORT_LOCAL_ENGINE", "SORT_NEGOTIATE", "SORT_RESTAGE",
+            "SORT_RESTAGE_RATIO", "SORT_NATIVE_ENCODE",
+        )
+        from mpitest_tpu.utils import native_encode
+
+        native_encode.engine()  # =on with no usable lib dies HERE
+    except (ValueError, RuntimeError) as e:
+        err(str(e))
+        return 1
+
+    from mpitest_tpu.serve.server import ServerCore, SortServer
+
+    def log(msg: str) -> None:
+        print(f"sort_server: {msg}", file=sys.stderr, flush=True)
+
+    core = ServerCore()
+    core.prewarm(log)
+    try:
+        server = SortServer(core, host, port)
+    except OSError as e:
+        err(f"cannot bind {host}:{port}: {e}")
+        return 1
+    stop = threading.Event()
+
+    def on_signal(signum: int, frame: object) -> None:
+        log(f"signal {signum}: draining (in-flight requests complete; "
+            "new work gets a typed 'draining' rejection)")
+        core.start_drain()
+        stop.set()
+
+    signal.signal(signal.SIGTERM, on_signal)
+    signal.signal(signal.SIGINT, on_signal)
+
+    serve_thread = threading.Thread(target=server.serve_forever,
+                                    name="serve-accept", daemon=True)
+    serve_thread.start()
+    # The sync line load generators / the selftest wait for (stdout, one
+    # line, flushed — parse the real bound port from it when PORT=0).
+    print(f"sort_server listening on {host}:{server.bound_port}",
+          flush=True)
+    stop.wait()
+    drained = core.drain_and_stop(timeout=60.0)
+    server.shutdown()
+    server.server_close()
+    log(f"drained={'clean' if drained else 'TIMEOUT'} "
+        f"served_ok={core.requests_ok} errors={core.requests_err} "
+        f"rejected={core.admission.rejected} "
+        f"batches={core.batcher.batches} "
+        f"cache_hits={core.cache.stats.hits} "
+        f"cache_misses={core.cache.stats.misses}")
+    return 0 if drained else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -46,7 +46,9 @@ from mpitest_tpu.utils.span_schema import (BALANCE_SPAN, FAULT_SPAN,
                                            INGEST_HOST_STAGES,
                                            INGEST_XFER_STAGES, PHASE_PREFIX,
                                            RESTAGE_SPAN, RETRY_SPAN,
-                                           VERIFY_SPAN)
+                                           SERVE_BATCH_SPAN,
+                                           SERVE_CACHE_SPAN,
+                                           SERVE_REQUEST_SPAN, VERIFY_SPAN)
 from mpitest_tpu.utils.spans import (MPI_EQUIV, SCHEMA as SPAN_SCHEMA,
                                      merge_intervals, overlap_seconds)
 
@@ -136,6 +138,13 @@ def aggregate(rows: list[dict]) -> dict:
     # into one table so a chaos run's telemetry is one `report` away.
     robust = {"faults": 0, "fault_sites": {}, "retries": 0,
               "verify_runs": 0, "verify_failures": 0}
+    # sort-as-a-service events (ISSUE 8): one serve.request span per
+    # served request (its duration is the SLO latency unit), one
+    # serve.batch per packed multi-tenant dispatch, one
+    # serve.compile_cache point event per executor-cache lookup.
+    serve = {"requests": [], "batches": 0, "batch_segments": 0,
+             "batch_keys": 0, "cache_hits": 0, "cache_misses": 0,
+             "compile_s": 0.0}
     # scale-out events (ISSUE 7): one exchange_balance event per
     # negotiated exchange (per-rank send/recv bytes, negotiated vs
     # worst-case capacity) + the restage count — the evidence row of
@@ -187,6 +196,26 @@ def aggregate(rows: list[dict]) -> dict:
                 scaleout["balance"].append(obj.get("attrs", {}))
             elif name == RESTAGE_SPAN:
                 scaleout["restages"] += 1
+            elif name == SERVE_REQUEST_SPAN:
+                a = obj.get("attrs", {})
+                serve["requests"].append(
+                    {"dt": float(obj.get("dt", 0.0)),
+                     "status": str(a.get("status", "?")),
+                     "batched": bool(a.get("batched")),
+                     "n": int(a.get("n", 0) or 0)})
+            elif name == SERVE_BATCH_SPAN:
+                a = obj.get("attrs", {})
+                serve["batches"] += 1
+                serve["batch_segments"] += int(a.get("segments", 0) or 0)
+                serve["batch_keys"] += int(a.get("keys", 0) or 0)
+            elif name == SERVE_CACHE_SPAN:
+                a = obj.get("attrs", {})
+                if a.get("hit"):
+                    serve["cache_hits"] += 1
+                else:
+                    serve["cache_misses"] += 1
+                    serve["compile_s"] += float(a.get("compile_s", 0.0)
+                                                or 0.0)
             elif name == VERIFY_SPAN:
                 robust["verify_runs"] += 1
                 if not obj.get("attrs", {}).get("ok", True):
@@ -247,7 +276,7 @@ def aggregate(rows: list[dict]) -> dict:
 
     return {"phases": phases, "collectives": colls, "metrics": metrics,
             "spans": span_counts, "ingest": ingest, "robustness": robust,
-            "scaleout": scaleout, "tooling": tooling,
+            "scaleout": scaleout, "serve": serve, "tooling": tooling,
             "encode_engines": sorted(encode_engines),
             "ingest_overlap": direction_overlap("ingest"),
             "egress_overlap": direction_overlap("egress")}
@@ -282,6 +311,51 @@ def scaleout_throughput(metrics: dict) -> list[dict]:
         if b and p8 and b["log2n"] == p8["log2n"] and b["value"] > 0:
             entry["speedup"] = round(p8["value"] / b["value"], 3)
         out.append(entry)
+    return out
+
+
+# ----------------------------------------------------------------- serve
+
+def percentile(sorted_values: list, q: float) -> float:
+    """Nearest-rank percentile of an ASCENDING-sorted list (the SLO
+    convention: p99 is the smallest value >= 99% of the samples)."""
+    if not sorted_values:
+        return 0.0
+    import math as _math
+
+    rank = max(1, _math.ceil(q / 100.0 * len(sorted_values)))
+    return float(sorted_values[rank - 1])
+
+
+def serve_slo(serve: dict) -> dict | None:
+    """Fold the serve.* span census into the SLO table (ISSUE 8):
+    p50/p99/mean request latency over SUCCESSFUL requests (an error is
+    an error budget line, not a latency sample), error counts by typed
+    code, the batched fraction, and the executor-cache hit ratio.
+    None when no serve activity was recorded."""
+    reqs = serve.get("requests", [])
+    if not reqs and not serve.get("batches") \
+            and not (serve.get("cache_hits") or serve.get("cache_misses")):
+        return None
+    ok = [r for r in reqs if r["status"] == "ok"]
+    lat = sorted(r["dt"] for r in ok)
+    errors: dict[str, int] = {}
+    for r in reqs:
+        if r["status"] != "ok":
+            errors[r["status"]] = errors.get(r["status"], 0) + 1
+    out = {
+        "requests": len(reqs), "ok": len(ok), "errors": errors,
+        "batched": sum(1 for r in ok if r["batched"]),
+        "keys": sum(r["n"] for r in ok),
+        "p50_ms": round(percentile(lat, 50) * 1e3, 3),
+        "p99_ms": round(percentile(lat, 99) * 1e3, 3),
+        "mean_ms": round(1e3 * sum(lat) / len(lat), 3) if lat else 0.0,
+        "batches": serve.get("batches", 0),
+        "batch_segments": serve.get("batch_segments", 0),
+        "cache_hits": serve.get("cache_hits", 0),
+        "cache_misses": serve.get("cache_misses", 0),
+        "compile_s": round(serve.get("compile_s", 0.0), 4),
+    }
     return out
 
 
@@ -470,6 +544,24 @@ def render(agg: dict) -> str:
             out.append(line)
         if so.get("restages"):
             out.append(f"  skew re-stages: {so['restages']}")
+    slo = serve_slo(agg.get("serve") or {})
+    if slo:
+        out.append("")
+        out.append("sort-as-a-service (serve.* spans — request latency SLO)")
+        out.append(f"  requests {slo['requests']} (ok {slo['ok']}, "
+                   f"batched {slo['batched']}, {slo['keys']} keys)"
+                   + ("; errors " + ", ".join(
+                       f"{k}={v}" for k, v in sorted(slo["errors"].items()))
+                      if slo["errors"] else ""))
+        out.append(f"  latency p50 {slo['p50_ms']} ms, "
+                   f"p99 {slo['p99_ms']} ms, mean {slo['mean_ms']} ms")
+        if slo["batches"]:
+            segs = slo["batch_segments"] / slo["batches"]
+            out.append(f"  batches {slo['batches']} "
+                       f"({segs:.1f} segments/dispatch)")
+        out.append(f"  executor cache: {slo['cache_hits']} hits, "
+                   f"{slo['cache_misses']} misses "
+                   f"({slo['compile_s']}s compiling)")
     rb = agg.get("robustness") or {}
     if any(rb.get(k) for k in ("faults", "retries", "verify_runs")):
         out.append("")

@@ -1,0 +1,87 @@
+"""Admission control: bounded in-flight work with typed backpressure.
+
+A server without admission control has a failure mode worse than
+rejection: the queue grows until host memory (or the batch window's
+latency SLO) dies.  This module bounds BOTH axes the sort server cares
+about — concurrent request count (``SORT_SERVE_MAX_INFLIGHT``) and
+total in-flight payload bytes (``SORT_SERVE_MAX_BYTES``) — and turns an
+over-limit arrival into a :class:`AdmissionReject` whose ``reason`` is
+machine-readable, so clients can tell "back off" (``inflight`` /
+``bytes``) from "the server is going away" (``draining``).
+
+The protocol maps a rejection to one typed error response; nothing
+about an over-limit request ever reaches the device."""
+
+from __future__ import annotations
+
+import threading
+
+
+class AdmissionReject(RuntimeError):
+    """Typed backpressure rejection.  ``reason`` ∈ {"inflight",
+    "bytes", "draining"}; the wire protocol forwards it verbatim."""
+
+    def __init__(self, reason: str, detail: str) -> None:
+        super().__init__(detail)
+        self.reason = reason
+
+
+class AdmissionControl:
+    """Counting admission gate.  ``admit(nbytes)`` either reserves
+    capacity or raises :class:`AdmissionReject`; ``release(nbytes)``
+    returns it (call exactly once per successful admit — the server's
+    request handler does both in one try/finally)."""
+
+    def __init__(self, max_inflight: int, max_bytes: int) -> None:
+        self.max_inflight = int(max_inflight)
+        self.max_bytes = int(max_bytes)
+        self.inflight = 0
+        self.inflight_bytes = 0
+        self.rejected = 0
+        self.admitted = 0
+        self.draining = False
+        self._lock = threading.Lock()
+        self._idle = threading.Condition(self._lock)
+
+    def admit(self, nbytes: int) -> None:
+        with self._lock:
+            if self.draining:
+                self.rejected += 1
+                raise AdmissionReject(
+                    "draining", "server is draining (SIGTERM received); "
+                    "not accepting new work")
+            if self.inflight + 1 > self.max_inflight:
+                self.rejected += 1
+                raise AdmissionReject(
+                    "inflight",
+                    f"in-flight request limit reached "
+                    f"({self.max_inflight}); retry with backoff")
+            if self.inflight_bytes + nbytes > self.max_bytes:
+                self.rejected += 1
+                raise AdmissionReject(
+                    "bytes",
+                    f"in-flight byte limit reached ({self.max_bytes}); "
+                    "retry with backoff")
+            self.inflight += 1
+            self.inflight_bytes += nbytes
+            self.admitted += 1
+
+    def release(self, nbytes: int) -> None:
+        with self._lock:
+            self.inflight -= 1
+            self.inflight_bytes -= nbytes
+            if self.inflight == 0:
+                self._idle.notify_all()
+
+    def start_drain(self) -> None:
+        """Flip to draining: every subsequent admit is a typed
+        rejection; in-flight work is unaffected."""
+        with self._lock:
+            self.draining = True
+
+    def wait_idle(self, timeout: float | None = None) -> bool:
+        """Block until no requests are in flight (the SIGTERM drain
+        barrier).  Returns False on timeout."""
+        with self._lock:
+            return self._idle.wait_for(lambda: self.inflight == 0,
+                                       timeout=timeout)

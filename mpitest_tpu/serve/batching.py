@@ -1,0 +1,182 @@
+"""Multi-tenant batching: pack concurrent small requests into one dispatch.
+
+One dispatch of the packed program costs roughly the same wall time as a
+dispatch for a single small request — the fixed per-launch overhead
+(host staging, program launch, result sync) dominates at small N.  The
+batcher therefore runs ONE dispatch thread that, on picking up a
+batchable request, keeps collecting compatible requests for at most
+``SORT_SERVE_BATCH_WINDOW_MS`` (or until ``SORT_SERVE_BATCH_KEYS`` keys
+are packed) and hands the group to the server's batch runner — under
+closed-loop small-request load, K tenants share one device launch
+instead of paying K.
+
+Compatibility is dtype equality (segments share one packed word
+layout).  Requests that are too large, carry a per-request fault spec,
+or arrive with batching disabled (window 0) dispatch alone, in arrival
+order, on the same thread — a single dispatcher also serializes device
+access, so batched and solo work never contend for the mesh.
+
+The dispatch thread is the only thread that touches JAX; request
+handler threads only enqueue and wait on per-request completion events.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Callable
+
+import numpy as np
+
+if TYPE_CHECKING:
+    pass
+
+#: Sentinel that tells the dispatch thread to finish the queue and exit.
+_STOP = object()
+
+
+@dataclass
+class ServeRequest:
+    """One admitted request riding the dispatch queue.  The handler
+    thread blocks on ``done``; the dispatch thread fills exactly one of
+    ``result`` / ``error`` and sets it."""
+
+    arr: np.ndarray
+    dtype: np.dtype
+    algo: str
+    batchable: bool
+    faults: str | None = None
+    t_enq: float = field(default_factory=time.perf_counter)
+    done: threading.Event = field(default_factory=threading.Event)
+    result: np.ndarray | None = None
+    error: tuple[str, str] | None = None    # (code, detail)
+    batched: bool = False
+    bucket: int | None = None
+
+    @property
+    def n(self) -> int:
+        return int(self.arr.size)
+
+    def complete(self, out: np.ndarray, batched: bool,
+                 bucket: int | None) -> None:
+        self.result = out
+        self.batched = batched
+        self.bucket = bucket
+        self.done.set()
+
+    def fail(self, code: str, detail: str) -> None:
+        self.error = (code, detail)
+        self.done.set()
+
+
+class Batcher:
+    """The dispatch loop.  ``run_batch(requests)`` / ``run_solo(request)``
+    are the server's executors; both must complete/fail every request
+    they are handed (the loop itself never touches results)."""
+
+    def __init__(self, run_batch: Callable[[list[ServeRequest]], None],
+                 run_solo: Callable[[ServeRequest], None],
+                 window_s: float, batch_keys: int) -> None:
+        self.run_batch = run_batch
+        self.run_solo = run_solo
+        self.window_s = float(window_s)
+        self.batch_keys = int(batch_keys)
+        self._q: "queue.Queue[object]" = queue.Queue()
+        self._pending: list[ServeRequest] = []  # incompatibles set aside
+        self._stopping = False
+        self.batches = 0
+        self.batched_requests = 0
+        self.solo_requests = 0
+        self._thread = threading.Thread(target=self._loop,
+                                        name="serve-dispatch", daemon=True)
+        self._thread.start()
+
+    def submit(self, req: ServeRequest) -> None:
+        self._q.put(req)
+
+    def _guarded(self, thunk: "Callable[[], None]",
+                 reqs: list[ServeRequest]) -> None:
+        """Run an executor under a blanket guard: the dispatch thread
+        must survive ANY executor failure (the executors are typed
+        internally, but e.g. a span-stream disk-full OSError escaping
+        would otherwise kill the only thread that completes requests,
+        wedging every future request for the full completion timeout).
+        Requests the executor never completed fail typed instead."""
+        try:
+            thunk()
+        except BaseException as e:  # noqa: BLE001 — thread survival
+            for r in reqs:
+                if not r.done.is_set():
+                    r.fail("internal",
+                           f"dispatcher error: {type(e).__name__}: {e}")
+
+    def stop(self, timeout: float = 60.0) -> None:
+        """Finish everything already enqueued, then stop the dispatch
+        thread (the drain path: admission already rejects new work)."""
+        self._q.put(_STOP)
+        self._thread.join(timeout)
+
+    # -- dispatch loop ------------------------------------------------
+    def _next(self, timeout: float | None) -> object | None:
+        if self._pending:
+            return self._pending.pop(0)
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def _loop(self) -> None:
+        while True:
+            item = self._next(timeout=0.1 if self._stopping else None)
+            if item is None:
+                if self._stopping and self._q.empty():
+                    return
+                continue
+            if item is _STOP:
+                self._stopping = True
+                continue
+            req = item  # type: ignore[assignment]
+            if not isinstance(req, ServeRequest):
+                continue
+            if not req.batchable or req.faults is not None:
+                self.solo_requests += 1
+                self._guarded(lambda r=req: self.run_solo(r), [req])
+                continue
+            batch = [req]
+            total = req.n
+            if self.window_s > 0:
+                deadline = time.monotonic() + self.window_s
+                while total < self.batch_keys:
+                    slack = deadline - time.monotonic()
+                    if slack <= 0:
+                        break
+                    try:
+                        nxt = self._q.get(timeout=slack)
+                    except queue.Empty:
+                        break
+                    if nxt is _STOP:
+                        self._stopping = True
+                        continue
+                    cand = nxt  # type: ignore[assignment]
+                    if (isinstance(cand, ServeRequest) and cand.batchable
+                            and cand.faults is None
+                            and cand.dtype == req.dtype
+                            and total + cand.n <= self.batch_keys):
+                        batch.append(cand)
+                        total += cand.n
+                    else:
+                        # incompatible (dtype mix, solo-only, or the
+                        # batch would overflow): set it aside for the
+                        # next iteration and close this batch — simple
+                        # FIFO fairness beats clever repacking at a
+                        # 2 ms window
+                        self._pending.append(cand)  # type: ignore[arg-type]
+                        break
+            # window 0 degenerates to per-request dispatch — still
+            # through the packed path, so the executor cache serves the
+            # sequential mode warm too (the A/B the selftest measures)
+            self.batches += 1
+            self.batched_requests += len(batch)
+            self._guarded(lambda b=batch: self.run_batch(b), batch)

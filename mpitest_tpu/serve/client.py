@@ -1,0 +1,95 @@
+"""Client for the sort server's ``sortserve.v1`` wire protocol.
+
+Used by ``bench/serve_load.py`` (the closed-loop load generator), the
+tests, and anything else that wants a remote sort.  One
+:class:`ServeClient` holds one TCP connection and may issue many
+requests back to back (the server keeps the connection open across
+requests); a typed error response comes back as a :class:`ServeReply`
+with ``ok=False`` and the server's stable ``error`` code — the client
+never raises on a *server-side* rejection, only on transport failure.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+from dataclasses import dataclass
+
+import numpy as np
+
+#: Must match serve/server.py (kept literal here so the client is
+#: importable without the server stack).
+WIRE_SCHEMA = "sortserve.v1"
+
+
+@dataclass
+class ServeReply:
+    """One response: ``ok`` + sorted ``arr``, or a typed error."""
+
+    ok: bool
+    header: dict
+    arr: np.ndarray | None = None
+
+    @property
+    def error(self) -> str | None:
+        return None if self.ok else str(self.header.get("error"))
+
+    @property
+    def detail(self) -> str:
+        return str(self.header.get("detail", ""))
+
+
+class ServeClient:
+    """One persistent connection to a sort server."""
+
+    def __init__(self, host: str, port: int, timeout: float = 120.0) -> None:
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self._rfile = self.sock.makefile("rb")
+
+    def close(self) -> None:
+        try:
+            self._rfile.close()
+        finally:
+            self.sock.close()
+
+    def __enter__(self) -> "ServeClient":
+        return self
+
+    def __exit__(self, *exc: object) -> None:
+        self.close()
+
+    def sort(self, arr: np.ndarray, algo: str | None = None,
+             faults: str | None = None) -> ServeReply:
+        """Send one sort request; block for the reply."""
+        arr = np.ascontiguousarray(arr).reshape(-1)
+        hdr: dict = {"v": WIRE_SCHEMA, "dtype": arr.dtype.name,
+                     "n": int(arr.size)}
+        if algo is not None:
+            hdr["algo"] = algo
+        if faults is not None:
+            hdr["faults"] = faults
+        self.sock.sendall(json.dumps(hdr).encode("utf-8") + b"\n"
+                          + arr.tobytes())
+        line = self._rfile.readline()
+        if not line:
+            raise ConnectionError("server closed the connection "
+                                  "without a response header")
+        resp = json.loads(line.decode("utf-8"))
+        if not resp.get("ok"):
+            return ServeReply(False, resp)
+        nbytes = int(resp["n"]) * np.dtype(str(resp["dtype"])).itemsize
+        payload = self._rfile.read(nbytes)
+        if len(payload) != nbytes:
+            raise ConnectionError(
+                f"short response payload ({len(payload)}/{nbytes})")
+        out = np.frombuffer(payload,
+                            dtype=np.dtype(str(resp["dtype"]))).copy()
+        return ServeReply(True, resp, out)
+
+
+def sort_once(host: str, port: int, arr: np.ndarray,
+              algo: str | None = None, faults: str | None = None,
+              timeout: float = 120.0) -> ServeReply:
+    """One-shot convenience: connect, sort, close."""
+    with ServeClient(host, port, timeout=timeout) as c:
+        return c.sort(arr, algo=algo, faults=faults)

@@ -1,0 +1,136 @@
+"""AOT executable cache: compile once per shape bucket, serve warm forever.
+
+The compile is the dominant fixed cost of a small sort — hundreds of
+milliseconds against sub-millisecond device work.  The cache removes it
+from the request path twice over:
+
+* **Shape bucketing** (:func:`bucket_for`, re-exported from
+  ``models/segmented.py``): request/batch sizes round up to powers of
+  two, so an unbounded family of request shapes maps to a handful of
+  executables.  A 1300-key batch and a 1900-key batch both run the
+  2048-lane program; the pad lanes sort to the tail and cost nanoseconds.
+* **AOT compilation**: entries are built with
+  ``jit(...).lower(...).compile()`` — the executable exists before the
+  first request needs it (prewarm) or is built exactly once on first
+  miss.  Warm requests call a finished executable; the selftest gate
+  asserts a warm window records ZERO compile activity.
+
+Every lookup emits a ``serve.compile_cache`` point event (hit/miss,
+bucket, dtype, compile seconds on miss) so cache behavior is observable
+in the same span stream as request latency.
+
+Startup prewarm on a TPU backend runs behind the bounded topology probe
+(:mod:`mpitest_tpu.utils.topology_probe`): on images where the TPU
+compiler rides a tunnel, an unreachable tunnel makes the first compile
+block forever HOLDING THE GIL — probing in a killable subprocess first
+lets the server degrade to jit-on-first-use and still come up, instead
+of wedging before it can accept a request."""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Any, Callable
+
+from mpitest_tpu.models.segmented import (MIN_BUCKET, bucket_for,
+                                          compile_packed_sort)
+
+if TYPE_CHECKING:
+    from mpitest_tpu.utils.spans import SpanLog
+
+__all__ = ["CacheStats", "ExecutorCache", "MIN_BUCKET", "bucket_for"]
+
+
+@dataclass
+class CacheStats:
+    hits: int = 0
+    misses: int = 0
+    compile_s: float = 0.0
+    prewarmed: int = 0
+    buckets: set = field(default_factory=set)
+
+
+class ExecutorCache:
+    """Memoized AOT executables keyed by (kind, bucket, dtype name,
+    total word count, mesh fingerprint).  ``dtype``/``mesh`` ride the
+    key for honesty (an entry is only ever reused for the exact
+    configuration it was built for) even where the underlying program
+    depends on fewer coordinates — the packed sort is shape+word-count
+    only, so e.g. int32 and uint32 share a *compile* via the lru-cached
+    builder while keeping distinct cache entries and telemetry."""
+
+    def __init__(self, spans: "SpanLog | None" = None) -> None:
+        self._entries: dict[tuple, Callable[..., Any]] = {}
+        self._lock = threading.Lock()
+        self.stats = CacheStats()
+        self.spans = spans
+
+    # -- events -------------------------------------------------------
+    def _event(self, **attrs: object) -> None:
+        if self.spans is not None:
+            self.spans.record("serve.compile_cache", time.perf_counter(),
+                              0.0, **attrs)
+
+    # -- lookup -------------------------------------------------------
+    def get_packed(self, bucket: int, dtype_name: str,
+                   n_words_total: int) -> Callable[..., Any]:
+        """The compiled packed-batch executable for a shape bucket —
+        the batcher's hot path.  First call per key compiles (one
+        ``serve.compile_cache`` miss event with the compile seconds);
+        every later call is a dict lookup."""
+        key = ("packed", bucket, dtype_name, n_words_total)
+        with self._lock:
+            exe = self._entries.get(key)
+            if exe is not None:
+                self.stats.hits += 1
+                self._event(hit=True, bucket=bucket, dtype=dtype_name)
+                return exe
+            # compile under the lock: two threads racing on a cold key
+            # would otherwise both pay the compile (the dispatch thread
+            # is single today, but the contract shouldn't depend on it)
+            t0 = time.perf_counter()
+            exe = compile_packed_sort(n_words_total, bucket)
+            dt = time.perf_counter() - t0
+            self._entries[key] = exe
+            self.stats.misses += 1
+            self.stats.compile_s += dt
+            self.stats.buckets.add(bucket)
+            self._event(hit=False, bucket=bucket, dtype=dtype_name,
+                        compile_s=round(dt, 6))
+            return exe
+
+    # -- prewarm ------------------------------------------------------
+    def prewarm(self, buckets: "tuple[int, ...]", dtype_names: tuple,
+                log: Callable[[str], None] = lambda m: None) -> int:
+        """AOT-compile the configured shape buckets before the first
+        request (``SORT_SERVE_SHAPE_BUCKETS`` × prewarm dtypes).  On a
+        TPU backend the bounded topology probe runs FIRST: if the
+        compiler path does not answer, prewarm is skipped with a loud
+        log line and the server degrades to jit-on-first-use — it never
+        wedges at startup holding the GIL.  Returns the number of
+        executables built."""
+        import jax
+
+        from mpitest_tpu.ops.keys import codec_for
+
+        if jax.default_backend() == "tpu":
+            from mpitest_tpu.utils.topology_probe import probe_tpu_compiler
+
+            reason = probe_tpu_compiler()
+            if reason:
+                log(f"prewarm skipped ({reason}); executables will "
+                    "compile on first use")
+                return 0
+        import numpy as np
+
+        built = 0
+        for dtype_name in dtype_names:
+            n_words = codec_for(np.dtype(dtype_name)).n_words
+            for b in buckets:
+                self.get_packed(b, dtype_name, 1 + n_words)
+                built += 1
+        self.stats.prewarmed += built
+        log(f"prewarmed {built} executable(s) "
+            f"(buckets {sorted(self.stats.buckets)})")
+        return built

@@ -1,0 +1,446 @@
+"""The sort server: protocol, orchestration, per-request supervision.
+
+Wire protocol (``sortserve.v1``, one TCP connection may carry many
+requests back to back):
+
+* request: one JSON header line (utf-8, ``\\n``-terminated) —
+  ``{"v": "sortserve.v1", "dtype": "int32", "n": 4096}`` with optional
+  ``"algo"`` (radix | sample; solo dispatches only) and ``"faults"``
+  (a ``SORT_FAULTS`` spec, honored only when the server runs with
+  ``SORT_SERVE_ALLOW_FAULTS=1``) — followed by exactly
+  ``n * itemsize`` raw little-endian key bytes.
+* response: one JSON header line — ``{"ok": true, "n": ..., "batched":
+  ..., "bucket": ..., "latency_ms": ...}`` followed by the sorted key
+  bytes, or ``{"ok": false, "error": <code>, "detail": ...}`` with no
+  payload.  Error codes are TYPED and stable: ``bad_request`` (the
+  header/payload is malformed), ``backpressure`` (admission bounds hit
+  — retry with backoff), ``draining`` (SIGTERM received), ``integrity``
+  (no path produced a verified result for THIS request),
+  ``retries`` (dispatch kept failing past the retry budget),
+  ``internal`` (anything else — still one request's problem, never the
+  server's).
+
+Failure semantics: every dispatch runs under the PR 3 robustness layer.
+Solo requests go through the supervised ``models.api.sort`` (bounded
+retry, degradation ladder, always-on verification); batched requests
+are verified PER SEGMENT (``models/segmented.verify_segments``) and a
+failing segment is re-run solo under the supervisor while its
+batchmates' verified results return normally.  A poisoned request —
+injected via ``SORT_FAULTS`` on the server or a per-request ``faults``
+spec in test mode — therefore yields a typed per-request error, never
+server death and never a batchmate's corruption.
+
+Telemetry: every request records a ``serve.request`` span (n, dtype,
+status, batched, bucket) whose duration feeds the report CLI's p50/p99
+SLO table; every packed dispatch records ``serve.batch``; every
+executor-cache lookup records ``serve.compile_cache``.  All ride the
+ordinary ``SORT_TRACE`` stream.
+"""
+
+from __future__ import annotations
+
+import json
+import socketserver
+import threading
+import time
+from typing import TYPE_CHECKING, Any, BinaryIO
+
+import numpy as np
+
+from mpitest_tpu import faults
+from mpitest_tpu.models import segmented
+from mpitest_tpu.models import supervisor as supervision
+from mpitest_tpu.serve.admission import AdmissionControl, AdmissionReject
+from mpitest_tpu.serve.batching import Batcher, ServeRequest
+from mpitest_tpu.serve.executor_cache import ExecutorCache
+from mpitest_tpu.utils import knobs
+
+if TYPE_CHECKING:
+    from jax.sharding import Mesh
+
+    from mpitest_tpu.utils.trace import Tracer
+
+#: Protocol version tag (header "v" of every request and response).
+WIRE_SCHEMA = "sortserve.v1"
+
+#: Typed error codes (stable wire vocabulary; see module docstring).
+ERR_BAD_REQUEST = "bad_request"
+ERR_BACKPRESSURE = "backpressure"
+ERR_DRAINING = "draining"
+ERR_INTEGRITY = "integrity"
+ERR_RETRIES = "retries"
+ERR_INTERNAL = "internal"
+
+#: Sanity cap on a single request's key count (the admission byte bound
+#: is the real limit; this just stops a hostile header from asking the
+#: server to read exabytes to keep framing).
+MAX_REQUEST_KEYS = 1 << 31
+
+#: Completion backstop: a request whose dispatch never completes (a
+#: dispatcher bug — should be impossible) fails typed instead of
+#: hanging its connection forever.
+_COMPLETION_TIMEOUT_S = 600.0
+
+
+def _maybe_corrupt_packed(reg: "faults.FaultRegistry | None",
+                          words: tuple,
+                          n_valid: int) -> tuple:
+    """Batch-path twin of ``faults.maybe_corrupt_result``: apply the
+    ``result_swap`` / ``result_dup`` sites to the packed host words so
+    server-level ``SORT_FAULTS`` chaos drills reach the batched
+    dispatch too.  The per-segment verifier must then flag (only) the
+    touched segments."""
+    if reg is None or n_valid < 2:
+        return words
+    for site in ("result_swap", "result_dup"):
+        if not reg.would_fire(site):
+            continue
+        if not reg.fire(site):
+            continue
+        out = []
+        for w in words:
+            h = w.copy()
+            if site == "result_swap":
+                h[0], h[n_valid - 1] = h[n_valid - 1].copy(), h[0].copy()
+            else:
+                h[1] = h[0]
+            out.append(h)
+        return tuple(out)
+    return words
+
+
+class ServerCore:
+    """Transport-independent server core: admission → batcher →
+    executor cache → supervised dispatch → typed result.  The TCP layer
+    (:class:`SortServer`) and the in-process tests both drive this."""
+
+    def __init__(self, mesh: "Mesh | None" = None,
+                 tracer: "Tracer | None" = None) -> None:
+        from mpitest_tpu.parallel.mesh import make_mesh
+        from mpitest_tpu.utils.trace import Tracer as _Tracer
+
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.tracer = tracer or _Tracer()
+        trace_path = knobs.get("SORT_TRACE")
+        if trace_path and self.tracer.spans.stream_path is None:
+            self.tracer.spans.stream_path = trace_path
+        self.default_algo = knobs.get("SORT_ALGO")
+        self.allow_faults = knobs.get("SORT_SERVE_ALLOW_FAULTS")
+        self.batch_keys = knobs.get("SORT_SERVE_BATCH_KEYS")
+        window_ms = knobs.get("SORT_SERVE_BATCH_WINDOW_MS")
+        self.cache = ExecutorCache(self.tracer.spans)
+        self.admission = AdmissionControl(
+            knobs.get("SORT_SERVE_MAX_INFLIGHT"),
+            knobs.get("SORT_SERVE_MAX_BYTES"))
+        self.batcher = Batcher(self._run_batch, self._run_solo,
+                               window_ms / 1e3, self.batch_keys)
+        self.requests_ok = 0
+        self.requests_err = 0
+        #: guards the two tallies above — _finish runs on concurrent
+        #: TCP handler threads, and a bare += loses increments.
+        self._tally_lock = threading.Lock()
+
+    # -- startup ------------------------------------------------------
+    def prewarm(self, log: Any = None) -> int:
+        """AOT-prewarm the executor cache (``SORT_SERVE_PREWARM`` /
+        ``SORT_SERVE_SHAPE_BUCKETS``); returns executables ensured."""
+        if knobs.get("SORT_SERVE_PREWARM") == "off":
+            return 0
+        log = log or (lambda m: None)
+        buckets = tuple(1 << int(b)
+                        for b in knobs.get("SORT_SERVE_SHAPE_BUCKETS"))
+        return self.cache.prewarm(buckets, ("int32",), log)
+
+    # -- dispatch executors (dispatch thread only) --------------------
+    def _run_solo(self, req: ServeRequest) -> None:
+        """One supervised sort for one request.  A per-request fault
+        spec (test mode) installs a scoped registry — the dispatch
+        thread is single, so install/clear cannot race another sort."""
+        from mpitest_tpu.models import api
+
+        reg = None
+        if req.faults is not None:
+            reg = faults.FaultRegistry(req.faults, seed=faults.faults_seed())
+        try:
+            if reg is not None:
+                faults.install(reg)
+            try:
+                out = api.sort(req.arr, algorithm=req.algo, mesh=self.mesh,
+                               tracer=self.tracer)
+            finally:
+                if reg is not None:
+                    faults.install(None)
+            req.complete(out, batched=False, bucket=None)
+        except supervision.SortIntegrityError as e:
+            req.fail(ERR_INTEGRITY, str(e))
+        except supervision.SortRetryExhausted as e:
+            req.fail(ERR_RETRIES, str(e))
+        except (ValueError, TypeError, OverflowError) as e:
+            req.fail(ERR_BAD_REQUEST, str(e))
+        except Exception as e:  # noqa: BLE001 — one request's problem,
+            req.fail(ERR_INTERNAL, f"{type(e).__name__}: {e}")  # never the server's
+
+    def _run_batch(self, reqs: "list[ServeRequest]") -> None:
+        """One packed multi-tenant dispatch.  Per-segment verification
+        isolates a bad segment: it re-runs solo under the supervisor,
+        its batchmates' verified results return normally."""
+        t0 = time.perf_counter()
+        dtype = reqs[0].dtype
+        try:
+            batch = segmented.pack_segments([r.arr for r in reqs], dtype)
+            exe = self.cache.get_packed(batch.bucket, dtype.name,
+                                        len(batch.words))
+            sorted_words = segmented.run_packed(batch, exe)
+            reg = faults.for_run()
+            supervision.wire_registry(reg, self.tracer)
+            sorted_words = _maybe_corrupt_packed(reg, sorted_words,
+                                                 batch.n_valid)
+            verdicts = segmented.verify_segments(batch, sorted_words)
+            outs = segmented.split_segments(batch, sorted_words)
+        except Exception as e:  # noqa: BLE001 — pack/dispatch died:
+            # nothing was verified; every tenant falls back to its own
+            # supervised solo run (typed per-request outcome)
+            self.tracer.count("serve_batch_fallbacks", 1)
+            self.tracer.verbose(f"batch dispatch failed "
+                                f"({type(e).__name__}: {e}); "
+                                "re-running each request solo")
+            for r in reqs:
+                self._run_solo(r)
+            return
+        self.tracer.spans.record(
+            "serve.batch", t0, time.perf_counter() - t0,
+            segments=len(reqs), keys=batch.n_valid, bucket=batch.bucket,
+            dtype=dtype.name)
+        for r, ok, out in zip(reqs, verdicts, outs):
+            if ok:
+                r.complete(out, batched=True, bucket=batch.bucket)
+            else:
+                self.tracer.count("serve_segment_requeues", 1)
+                self.tracer.verbose(
+                    "batched segment failed verification; re-running "
+                    "that request solo under the supervisor")
+                self._run_solo(r)
+
+    # -- request execution (any handler thread) -----------------------
+    def _finish(self, t0: float, attrs: dict, status: str,
+                payload: Any) -> tuple[str, Any, dict]:
+        """Record the ``serve.request`` span — the SLO unit — and the
+        served/errored tallies; every request path ends here exactly
+        once."""
+        attrs["status"] = status
+        self.tracer.spans.record("serve.request", t0,
+                                 time.perf_counter() - t0, **attrs)
+        with self._tally_lock:
+            if status == "ok":
+                self.requests_ok += 1
+            else:
+                self.requests_err += 1
+        return status, payload, attrs
+
+    @staticmethod
+    def reject_code(e: AdmissionReject) -> str:
+        return ERR_DRAINING if e.reason == "draining" else ERR_BACKPRESSURE
+
+    def _dispatch_admitted(self, t0: float, attrs: dict, arr: np.ndarray,
+                           algo: str | None, faults_spec: str | None,
+                           ) -> tuple[str, Any, dict]:
+        """Dispatch an ALREADY-ADMITTED request and wait for completion.
+        The caller owns the admission release."""
+        req = ServeRequest(
+            arr=arr, dtype=np.dtype(arr.dtype),
+            algo=algo or self.default_algo,
+            batchable=(faults_spec is None
+                       and int(arr.size) <= self.batch_keys),
+            faults=faults_spec)
+        self.batcher.submit(req)
+        if not req.done.wait(_COMPLETION_TIMEOUT_S):
+            return self._finish(t0, attrs, ERR_INTERNAL,
+                                "dispatch timed out")
+        attrs["batched"] = req.batched
+        if req.bucket is not None:
+            attrs["bucket"] = req.bucket
+        if req.error is not None:
+            return self._finish(t0, attrs, req.error[0], req.error[1])
+        return self._finish(t0, attrs, "ok", req.result)
+
+    def execute(self, arr: np.ndarray, algo: str | None = None,
+                faults_spec: str | None = None,
+                ) -> tuple[str, Any, dict]:
+        """Admit, dispatch and complete one request (the in-process
+        entry; the wire path admits BEFORE materializing the payload —
+        see :meth:`handle_wire`).  Returns ``(status, payload, attrs)``
+        where status ``"ok"`` carries the sorted array and any error
+        status carries the detail string."""
+        t0 = time.perf_counter()
+        nbytes = int(arr.nbytes)
+        attrs: dict = {"n": int(arr.size), "dtype": str(arr.dtype)}
+        try:
+            self.admission.admit(nbytes)
+        except AdmissionReject as e:
+            attrs["reject"] = e.reason
+            return self._finish(t0, attrs, self.reject_code(e), str(e))
+        try:
+            return self._dispatch_admitted(t0, attrs, arr, algo,
+                                           faults_spec)
+        finally:
+            self.admission.release(nbytes)
+
+    # -- wire handling ------------------------------------------------
+    @staticmethod
+    def _discard(rfile: BinaryIO, nbytes: int) -> bool:
+        """Read and drop ``nbytes`` of payload in bounded chunks —
+        keeps the connection's framing after a semantic rejection
+        WITHOUT ever buffering the rejected payload (the admission
+        byte bound must bound memory, not just dispatch).  Returns
+        False on a short read (framing lost)."""
+        left = nbytes
+        while left > 0:
+            got = rfile.read(min(left, 1 << 20))
+            if not got:
+                return False
+            left -= len(got)
+        return True
+
+    def handle_wire(self, header_line: bytes,
+                    rfile: BinaryIO) -> tuple[dict, bytes, bool]:
+        """One request from the wire: parse the header, ADMIT (the
+        payload only enters host memory after the admission byte/count
+        bounds said yes), read the payload, execute, build the
+        response.  Returns ``(response header, response payload,
+        keep_alive)`` — ``keep_alive`` False means framing is lost
+        (unreadable header / short payload) and the connection must
+        close."""
+        def err(code: str, detail: str, keep: bool = True,
+                ) -> tuple[dict, bytes, bool]:
+            return ({"v": WIRE_SCHEMA, "ok": False, "error": code,
+                     "detail": detail}, b"", keep)
+
+        try:
+            hdr = json.loads(header_line.decode("utf-8"))
+            if not isinstance(hdr, dict):
+                raise ValueError("header is not an object")
+        except (UnicodeDecodeError, ValueError) as e:
+            return err(ERR_BAD_REQUEST, f"unreadable header: {e}",
+                       keep=False)
+        if hdr.get("v") != WIRE_SCHEMA:
+            return err(ERR_BAD_REQUEST,
+                       f"unknown protocol version {hdr.get('v')!r} "
+                       f"(want {WIRE_SCHEMA!r})", keep=False)
+        try:
+            dtype = np.dtype(str(hdr.get("dtype", "int32")))
+            from mpitest_tpu.ops.keys import codec_for
+
+            codec_for(dtype)  # rejects valid-but-unsupported dtypes
+        except Exception as e:  # noqa: BLE001 — typed wire error
+            return err(ERR_BAD_REQUEST, f"bad dtype: {e}", keep=False)
+        n = hdr.get("n")
+        if not isinstance(n, int) or not 1 <= n <= MAX_REQUEST_KEYS:
+            return err(ERR_BAD_REQUEST,
+                       f"bad n={n!r} (integer in [1, {MAX_REQUEST_KEYS}])",
+                       keep=False)
+        nbytes = n * dtype.itemsize
+        algo = hdr.get("algo")
+        if algo is not None and algo not in ("radix", "sample"):
+            # payload not read yet: framing is recoverable only by
+            # draining it (bounded chunks) before responding
+            keep = self._discard(rfile, nbytes)
+            return err(ERR_BAD_REQUEST,
+                       f"bad algo {algo!r} (radix | sample)", keep=keep)
+        faults_spec = hdr.get("faults")
+        if faults_spec is not None:
+            if not self.allow_faults:
+                keep = self._discard(rfile, nbytes)
+                return err(ERR_BAD_REQUEST,
+                           "per-request fault injection requires "
+                           "SORT_SERVE_ALLOW_FAULTS=1 on the server",
+                           keep=keep)
+            try:
+                faults.FaultRegistry(str(faults_spec))
+            except ValueError as e:
+                keep = self._discard(rfile, nbytes)
+                return err(ERR_BAD_REQUEST, str(e), keep=keep)
+        # Admission BEFORE the payload is materialized: a rejected
+        # request is drained in bounded chunks, so the in-flight byte
+        # bound really bounds host memory, not just dispatch.
+        t0 = time.perf_counter()
+        attrs: dict = {"n": n, "dtype": dtype.name}
+        try:
+            self.admission.admit(nbytes)
+        except AdmissionReject as e:
+            attrs["reject"] = e.reason
+            code, detail, _ = self._finish(t0, attrs,
+                                           self.reject_code(e), str(e))
+            keep = self._discard(rfile, nbytes)
+            return err(code, str(detail), keep=keep)
+        try:
+            payload = rfile.read(nbytes)
+            if len(payload) != nbytes:
+                # post-admission outcome like any other: it must land
+                # in the serve.request span stream / error tally too
+                detail = (f"short payload ({len(payload)}/{nbytes} "
+                          "bytes)")
+                self._finish(t0, attrs, ERR_BAD_REQUEST, detail)
+                return err(ERR_BAD_REQUEST, detail, keep=False)
+            arr = np.frombuffer(payload, dtype=dtype).copy()
+            del payload
+            status, result, attrs = self._dispatch_admitted(
+                t0, attrs, arr, algo,
+                str(faults_spec) if faults_spec is not None else None)
+        finally:
+            self.admission.release(nbytes)
+        if status != "ok":
+            return err(status, str(result))
+        resp = {"v": WIRE_SCHEMA, "ok": True, "n": n,
+                "dtype": dtype.name,
+                "batched": bool(attrs.get("batched")),
+                "bucket": attrs.get("bucket")}
+        return resp, np.ascontiguousarray(result).tobytes(), True
+
+    # -- lifecycle ----------------------------------------------------
+    def start_drain(self) -> None:
+        self.admission.start_drain()
+
+    def drain_and_stop(self, timeout: float = 60.0) -> bool:
+        """SIGTERM semantics: reject new work (typed ``draining``), let
+        in-flight requests complete, stop the dispatch thread.  Returns
+        True when everything drained inside ``timeout``."""
+        self.start_drain()
+        idle = self.admission.wait_idle(timeout)
+        self.batcher.stop(timeout=10.0)
+        return idle
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self) -> None:
+        core: ServerCore = self.server.core  # type: ignore[attr-defined]
+        while True:
+            line = self.rfile.readline(1 << 16)
+            if not line or not line.strip():
+                return
+            resp, payload, keep = core.handle_wire(line, self.rfile)
+            try:
+                self.wfile.write(json.dumps(resp).encode("utf-8") + b"\n")
+                if payload:
+                    self.wfile.write(payload)
+                self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError):
+                return
+            if not keep:
+                return
+
+
+class SortServer(socketserver.ThreadingTCPServer):
+    """TCP front end over a :class:`ServerCore`.  Handler threads only
+    parse/frame and block on completion events; all device work happens
+    on the core's single dispatch thread."""
+
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, core: ServerCore, host: str, port: int) -> None:
+        super().__init__((host, port), _Handler)
+        self.core = core
+
+    @property
+    def bound_port(self) -> int:
+        return int(self.server_address[1])

@@ -426,6 +426,100 @@ register("SORT_FAULTS_SEED", "int", 0, "an integer",
          "Seed of the splitmix64 stream fault corruption values draw from.",
          _parse_faults_seed)
 
+# Sort-as-a-service knobs (ISSUE 8: mpitest_tpu/serve/ + the
+# drivers/sort_server.py entry point).  All validated fail-fast at
+# server startup — garbage in any of them is one [ERROR] line, never a
+# traceback out of the first request.
+
+
+def _parse_port(raw: str) -> int:
+    try:
+        v = int(raw)
+    except ValueError:
+        v = -1
+    if not 0 <= v <= 65535:
+        raise KnobError(f"SORT_SERVE_PORT={raw!r}: use an integer in "
+                        "[0, 65535] (0 = ephemeral)") from None
+    return v
+
+
+register("SORT_SERVE_PORT", "int", 7077, "an integer in [0, 65535]",
+         "TCP port the sort server listens on (0 = ephemeral).",
+         _parse_port)
+register("SORT_SERVE_HOST", "str", "127.0.0.1", "a bind address",
+         "Address the sort server binds (default loopback).",
+         _passthrough)
+register("SORT_SERVE_MAX_INFLIGHT", "int", 64, "an integer >= 1",
+         "Admission bound: concurrent in-flight requests before typed "
+         "backpressure rejection.",
+         _int("SORT_SERVE_MAX_INFLIGHT", lo=1))
+register("SORT_SERVE_MAX_BYTES", "int", 1 << 28, "an integer >= 1",
+         "Admission bound: total in-flight request payload bytes.",
+         _int("SORT_SERVE_MAX_BYTES", lo=1))
+
+
+def _parse_window_ms(raw: str) -> float:
+    try:
+        v = float(raw)
+    except ValueError:
+        v = -1.0
+    if not (math.isfinite(v) and v >= 0.0):
+        raise KnobError(f"SORT_SERVE_BATCH_WINDOW_MS={raw!r}: use a "
+                        "finite number >= 0 (0 disables packing)")
+    return v
+
+
+register("SORT_SERVE_BATCH_WINDOW_MS", "float", 2.0, "a number >= 0",
+         "Batching window: how long a dispatch waits to pack more "
+         "small requests (0 = dispatch each alone).",
+         _parse_window_ms)
+register("SORT_SERVE_BATCH_KEYS", "int", 1 << 16, "an integer >= 1",
+         "Requests up to this many keys are batchable; one packed "
+         "dispatch carries at most this many keys.",
+         _int("SORT_SERVE_BATCH_KEYS", lo=1))
+
+
+def _parse_buckets(raw: str) -> tuple[int, ...]:
+    out = []
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            v = int(part)
+        except ValueError:
+            v = 0
+        if not 1 <= v <= 30:
+            raise KnobError(
+                f"SORT_SERVE_SHAPE_BUCKETS={raw!r}: use a comma list of "
+                "log2 bucket sizes in [1, 30]") from None
+        out.append(v)
+    if not out:
+        raise KnobError(f"SORT_SERVE_SHAPE_BUCKETS={raw!r}: use a comma "
+                        "list of log2 bucket sizes in [1, 30]")
+    return tuple(sorted(set(out)))
+
+
+# Default prewarm covers every bucket the packed path can actually
+# request: bucket_for() floors at 2^10 and a packed dispatch carries at
+# most SORT_SERVE_BATCH_KEYS (default 2^16) keys — prewarming outside
+# that range would pay startup compiles for executables no dispatch
+# ever uses while leaving reachable buckets to compile on the request
+# path (the warm-traffic latency spike prewarm exists to prevent).
+register("SORT_SERVE_SHAPE_BUCKETS", "csv", "10,11,12,13,14,15,16",
+         "comma list of log2 sizes in [1, 30]",
+         "Power-of-two shape buckets the executor cache prewarms at "
+         "server startup.",
+         _parse_buckets)
+register("SORT_SERVE_PREWARM", "enum", "auto", "auto | off",
+         "AOT-prewarm the executor cache at startup (off = "
+         "jit-on-first-use).",
+         _enum("SORT_SERVE_PREWARM", ("auto", "off")))
+register("SORT_SERVE_ALLOW_FAULTS", "flag", False, "1 | 0",
+         "Honor per-request fault-injection specs (test mode only; "
+         "production servers reject them as bad requests).",
+         _flag("SORT_SERVE_ALLOW_FAULTS"))
+
 # Bench-driver knobs (bench.py).
 
 
@@ -465,6 +559,10 @@ register("BENCH_NATIVE_REPEATS", "int", 3, "an integer >= 1",
 register("BENCH_MULTICHIP", "enum", "auto", "auto | off",
          "Emit the devices=8 bench row (real mesh, else cpu:8 fallback).",
          _enum("BENCH_MULTICHIP", ("auto", "off")))
+register("BENCH_SERVE", "enum", "auto", "auto | off",
+         "Emit the sort-as-a-service bench row (bench/serve_load.py "
+         "against a spawned server).",
+         _enum("BENCH_SERVE", ("auto", "off")))
 
 # Bench-script knobs (bench/*.py probes and batteries).
 

@@ -65,6 +65,14 @@ SPAN_NAMES: dict[str, str] = {
     "negotiate_probe": ("one capacity-negotiation count probe "
                         "(trace-time; its collectives nest here, "
                         "not under a pass)"),
+    # serve/ — sort-as-a-service vocabulary (ISSUE 8); the report CLI's
+    # SLO table computes p50/p99 latency from serve.request durations
+    "serve.request": ("one served sort request (n, dtype, status, "
+                      "batched, bucket) — the SLO latency unit"),
+    "serve.batch": ("one packed multi-tenant dispatch (segments, keys, "
+                    "bucket)"),
+    "serve.compile_cache": ("executor-cache lookup point event (hit, "
+                            "bucket, dtype; compile_s on miss)"),
     # models/ingest.py — streamed pipeline stages (ISSUE 2)
     "ingest.parse": "parse/materialize one host chunk",
     "ingest.encode": "codec-encode one chunk (worker pool)",
@@ -87,6 +95,11 @@ VERIFY_SPAN = "verify"
 #: Scale-out event names the report's scale-out table folds (ISSUE 7).
 BALANCE_SPAN = "exchange_balance"
 RESTAGE_SPAN = "restage"
+
+#: Sort-as-a-service names the report's SLO table folds (ISSUE 8).
+SERVE_REQUEST_SPAN = "serve.request"
+SERVE_BATCH_SPAN = "serve.batch"
+SERVE_CACHE_SPAN = "serve.compile_cache"
 
 
 def is_registered(name: str) -> bool:

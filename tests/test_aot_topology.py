@@ -12,9 +12,6 @@ executes on the virtual CPU mesh).
 
 from __future__ import annotations
 
-import subprocess
-import sys
-
 import numpy as np
 import pytest
 
@@ -25,55 +22,19 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from mpitest_tpu.models import radix_sort, sample_sort
 from mpitest_tpu.parallel.mesh import AXIS
 from mpitest_tpu import compat
-
-#: Bounded connect-probe budget.  On this image the TPU compiler rides
-#: a network tunnel; when it is unreachable, ``get_topology_desc``
-#: BLOCKS FOREVER at ~0% CPU **while holding the GIL** (PR 4 caution;
-#: the libtpu metadata fetch loops inside one C call) — so an
-#: in-process watchdog thread can never fire, and tier-1 used to wedge
-#: here until the suite timeout killed it.  The probe below therefore
-#: runs in a SUBPROCESS, which a timeout can always kill.  A reachable
-#: tunnel answers in low seconds; 45 s is comfortably past any healthy
-#: handshake.
-_PROBE_TIMEOUT_S = 45.0
-
-#: Probe verdict, cached for the module: None = not yet run,
-#: "" = tunnel reachable, anything else = the skip reason.
-_probe_result: str | None = None
-
-
-def _probe_tunnel() -> str:
-    """Run one throwaway ``get_topology_desc`` in a killable child
-    process.  Returns "" when the TPU-compiler path is usable, else the
-    reason every AOT test must skip.  Runs at most once per session."""
-    global _probe_result
-    if _probe_result is not None:
-        return _probe_result
-    code = ("from jax.experimental import topologies; "
-            "topologies.get_topology_desc(platform='tpu', "
-            "topology_name='v5e:2x4')")
-    try:
-        r = subprocess.run([sys.executable, "-c", code],
-                           capture_output=True, text=True,
-                           timeout=_PROBE_TIMEOUT_S)
-    except subprocess.TimeoutExpired:
-        _probe_result = (f"TPU topology probe timed out after "
-                         f"{_PROBE_TIMEOUT_S:.0f}s (compiler tunnel "
-                         "unreachable); AOT compiles skipped, not wedged")
-        return _probe_result
-    if r.returncode != 0:
-        tail = (r.stderr.strip().splitlines() or ["no error output"])[-1]
-        _probe_result = f"TPU topology AOT unavailable: {tail[:200]}"
-        return _probe_result
-    _probe_result = ""
-    return _probe_result
+# The bounded subprocess probe (PR 5's GIL-hang fix) now lives in
+# mpitest_tpu/utils/topology_probe.py, shared with the sort server's
+# executor cache (ISSUE 8): get_topology_desc blocks forever HOLDING
+# THE GIL on a tunnel-less image, so only a killable child process can
+# bound it.  The verdict is cached per process.
+from mpitest_tpu.utils.topology_probe import probe_tpu_compiler
 
 
 def _topology_or_skip(topology_name: str, num_slices: int | None = None):
     """``topologies.get_topology_desc`` behind the bounded connect
     probe: once the probe proves the tunnel answers, the in-process
     fetch is safe (same endpoint, already-warm metadata)."""
-    reason = _probe_tunnel()
+    reason = probe_tpu_compiler()
     if reason:
         pytest.skip(reason)
     try:

@@ -247,3 +247,63 @@ def test_ingest_overlap_groups_runs_by_pid(tmp_path):
     # [0.45,0.85)) must NOT count — both runs were serial
     assert ov["overlap_s"] == 0.0
     assert ov["transfer_s"] == pytest.approx(0.8)
+
+
+# ------------------------------------------------- serve SLO table (ISSUE 8)
+
+def _serve_req(i, dt, status="ok", batched=True, n=512):
+    return {"v": "span.v1", "name": "serve.request", "id": 100 + i,
+            "parent": None, "t0": float(i), "dt": dt, "pid": 1,
+            "attrs": {"n": n, "dtype": "int32", "status": status,
+                      "batched": batched}}
+
+
+SERVE_ROWS = (
+    [_serve_req(i, dt) for i, dt in
+     enumerate([0.010, 0.020, 0.030, 0.040, 0.200])]
+    + [_serve_req(9, 0.005, status="backpressure", batched=False),
+       _serve_req(10, 0.004, status="integrity", batched=False),
+       {"v": "span.v1", "name": "serve.batch", "id": 200, "parent": None,
+        "t0": 0.0, "dt": 0.003, "pid": 1,
+        "attrs": {"segments": 5, "keys": 2560, "bucket": 4096}},
+       {"v": "span.v1", "name": "serve.compile_cache", "id": 201,
+        "parent": None, "t0": 0.0, "dt": 0.0, "pid": 1,
+        "attrs": {"hit": False, "bucket": 4096, "dtype": "int32",
+                  "compile_s": 0.25}},
+       {"v": "span.v1", "name": "serve.compile_cache", "id": 202,
+        "parent": None, "t0": 0.1, "dt": 0.0, "pid": 1,
+        "attrs": {"hit": True, "bucket": 4096, "dtype": "int32"}}])
+
+
+def test_percentile_nearest_rank():
+    vals = sorted([1.0, 2.0, 3.0, 4.0, 100.0])
+    assert report.percentile(vals, 50) == 3.0
+    assert report.percentile(vals, 99) == 100.0
+    assert report.percentile([], 99) == 0.0
+    assert report.percentile([7.0], 50) == 7.0
+
+
+def test_serve_slo_aggregation(tmp_path):
+    p = write_jsonl(tmp_path / "serve.jsonl", SERVE_ROWS)
+    agg = report.aggregate(report.load_rows(p))
+    slo = report.serve_slo(agg["serve"])
+    # errors are error-budget lines, never latency samples
+    assert slo["requests"] == 7 and slo["ok"] == 5
+    assert slo["errors"] == {"backpressure": 1, "integrity": 1}
+    assert slo["batched"] == 5
+    assert slo["p50_ms"] == pytest.approx(30.0)
+    assert slo["p99_ms"] == pytest.approx(200.0)
+    assert slo["batches"] == 1 and slo["batch_segments"] == 5
+    assert slo["cache_hits"] == 1 and slo["cache_misses"] == 1
+    assert slo["compile_s"] == pytest.approx(0.25)
+    rendered = report.render(agg)
+    assert "sort-as-a-service" in rendered
+    assert "p99 200.0 ms" in rendered
+    assert "backpressure=1" in rendered
+
+
+def test_serve_slo_absent_without_serve_spans(tmp_path):
+    p = write_jsonl(tmp_path / "plain.jsonl", SPAN_ROWS)
+    agg = report.aggregate(report.load_rows(p))
+    assert report.serve_slo(agg["serve"]) is None
+    assert "sort-as-a-service" not in report.render(agg)

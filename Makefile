@@ -79,7 +79,7 @@ PYTHON ?= python3
 .PHONY: test native native-encode chip-test telemetry-selftest \
     ingest-selftest fault-selftest multichip-selftest serve-selftest \
     lint cwarn-check typecheck tidy-check knob-docs sanitize-selftest \
-    clean
+    bench-history clean
 
 chip-test:
 	$(PYTHON) -u bench/chip_regression.py
@@ -100,7 +100,15 @@ native-encode:
 
 # One-command proof that both telemetry producers emit what the report
 # CLI can validate: TPU span stream (SORT_TRACE) on a virtual CPU mesh
-# + native COMM_STATS from a pthreads sort, same tiny input.
+# + native COMM_STATS from a pthreads sort, same tiny input.  The LIVE
+# leg (ISSUE 10) then spins a real sort_server and proves the
+# operational layer: client trace ids echoed and reconstructable via
+# `report.py --trace-id` (queue wait, batch membership, dispatch,
+# reply), /metrics exposition valid with every exported name registered
+# and request counts reconciling exactly with the client, /healthz +
+# /varz + /flightrecorder + /profile live, a fault-injected typed error
+# leaving a flight-recorder artifact that `report.py --check` accepts,
+# and a SORT_TRACE_SAMPLE-downsampled stream still schema-valid.
 TELEMETRY_TMP := /tmp/mpitest_telemetry_selftest
 telemetry-selftest:
 	$(MAKE) -C mpi_radix_sort BACKEND=local
@@ -119,6 +127,16 @@ telemetry-selftest:
 	    $(TELEMETRY_TMP)/trace.jsonl $(TELEMETRY_TMP)/comm_stats.jsonl
 	$(PYTHON) -m mpitest_tpu.report \
 	    $(TELEMETRY_TMP)/trace.jsonl $(TELEMETRY_TMP)/comm_stats.jsonl
+	JAX_PLATFORMS=cpu \
+	    $(PYTHON) -u bench/telemetry_live_selftest.py \
+	    --out $(TELEMETRY_TMP)/live
+	$(PYTHON) -m mpitest_tpu.report --prom $(TELEMETRY_TMP)/live/scrape.prom
+
+# The BENCH_r01..rNN trajectory (throughput / ingest ratio / cap saving
+# / serve SLO) as one markdown table with per-metric regression flags —
+# the pinned snapshots nothing read across runs before ISSUE 10.
+bench-history:
+	$(PYTHON) tools/bench_history.py
 
 # The chaos matrix (ISSUE 3 acceptance gate) — see bench/fault_selftest.py.
 # Builds the native binaries the COMM_FAULTS drills target first.

@@ -46,6 +46,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import re
 import subprocess
 import sys
@@ -61,6 +62,7 @@ sys.path.insert(0, str(REPO))
 from mpitest_tpu.report import percentile          # noqa: E402
 from mpitest_tpu.serve.client import ServeClient   # noqa: E402
 from mpitest_tpu.utils import knobs                # noqa: E402
+from mpitest_tpu.utils import metrics_live         # noqa: E402
 
 #: Request-size mix: log-uniform in [2^LOG2_MIN, 2^LOG2_MAX] int32 keys
 #: — small enough that per-dispatch overhead (not O(n log n) sort work)
@@ -98,8 +100,6 @@ class Server:
 
     def __init__(self, out_dir: Path, tag: str,
                  env_overrides: dict | None = None) -> None:
-        import os
-
         self.trace = out_dir / f"server_trace_{tag}.jsonl"
         # stderr goes to a FILE, not a pipe: the child may log more
         # than a pipe buffer before binding (prewarm lines), and an
@@ -107,15 +107,20 @@ class Server:
         # timeout exists to bound.
         self.stderr_path = out_dir / f"server_{tag}.stderr.log"
         self._stderr_f = open(self.stderr_path, "w")
-        env = dict(os.environ,
-                   SORT_SERVE_PORT="0",
-                   SORT_TRACE=str(self.trace),
-                   **(env_overrides or {}))
+        # defaults first, THEN overrides — a caller may override any
+        # default (e.g. SORT_METRICS_PORT=-1), which dict(**kwargs)
+        # would reject as a duplicate keyword
+        env = dict(os.environ)
+        env.update({"SORT_SERVE_PORT": "0",
+                    "SORT_METRICS_PORT": "0",
+                    "SORT_TRACE": str(self.trace)})
+        env.update(env_overrides or {})
         self.proc = subprocess.Popen(
             [sys.executable, str(REPO / "drivers" / "sort_server.py")],
             stdout=subprocess.PIPE, stderr=self._stderr_f, text=True,
             env=env)
         assert self.proc.stdout is not None
+        self._stdout_buf = ""
         line = self._await_listening_line()
         m = re.search(r"listening on [\d.]+:(\d+)", line or "")
         if not m:
@@ -125,27 +130,60 @@ class Server:
                 f"server ({tag}) did not come up: {line!r}\n"
                 f"{self._stderr_tail()}")
         self.port = int(m.group(1))
-        log(f"server[{tag}] up on :{self.port}")
+        # second sync line (ISSUE 10): the telemetry side port.  Only
+        # awaited when the spawn env left metrics enabled.
+        self.metrics_port: int | None = None
+        if env.get("SORT_METRICS_PORT") != "-1":
+            mline = self._await_listening_line()
+            mm = re.search(r"metrics on [\d.]+:(\d+)", mline or "")
+            if not mm:
+                self.proc.kill()
+                self.proc.wait(timeout=10)
+                raise RuntimeError(
+                    f"server ({tag}) printed no metrics line: {mline!r}"
+                    f"\n{self._stderr_tail()}")
+            self.metrics_port = int(mm.group(1))
+        log(f"server[{tag}] up on :{self.port}"
+            + (f" (metrics :{self.metrics_port})"
+               if self.metrics_port else ""))
+
+    def scrape_metrics(self) -> str:
+        """One /metrics scrape (Prometheus text)."""
+        import urllib.request
+
+        assert self.metrics_port is not None, "metrics port disabled"
+        with urllib.request.urlopen(
+                f"http://{HOST}:{self.metrics_port}/metrics",
+                timeout=30) as r:
+            return r.read().decode("utf-8")
 
     def _await_listening_line(self) -> str:
-        """Bounded wait for the sync line: select() on the stdout pipe
-        so a child that hangs without printing fails at the deadline
-        instead of blocking readline() forever."""
+        """Bounded wait for ONE sync line: select() + os.read on the
+        raw fd with our own line buffer.  Two sync lines are read back
+        to back (listening + metrics) and they usually arrive in ONE
+        pipe chunk — a text-mode readline() would swallow both into
+        Python's internal buffer, and a later select() on the fd would
+        then block on data that already arrived."""
         import select
 
         deadline = time.monotonic() + self.STARTUP_TIMEOUT_S
         stdout = self.proc.stdout
         assert stdout is not None
+        fd = stdout.fileno()
         while time.monotonic() < deadline:
+            if "\n" in self._stdout_buf:
+                line, self._stdout_buf = self._stdout_buf.split("\n", 1)
+                return line + "\n"
             if self.proc.poll() is not None:
                 return ""          # child died before binding
-            ready, _, _ = select.select([stdout], [], [],
+            ready, _, _ = select.select([fd], [], [],
                                         min(1.0, deadline
                                             - time.monotonic()))
             if ready:
-                # the sync line is one atomic flushed print; readline
-                # after select readiness returns promptly
-                return stdout.readline()
+                chunk = os.read(fd, 1 << 16)
+                if not chunk:
+                    return ""      # EOF
+                self._stdout_buf += chunk.decode("utf-8", "replace")
         return ""
 
     def _stderr_tail(self, nbytes: int = 2000) -> str:
@@ -253,9 +291,55 @@ def run_load(port: int, requests: int, concurrency: int, seed: int,
         t.join()
     wall = time.perf_counter() - t0
     return {"wall_s": wall, "latencies": sorted(lat),
+            "latency_hist": latency_histogram(lat),
             "statuses": statuses, "keys": keys[0],
             "bad_parity": bad_parity[0],
             "keys_per_s": keys[0] / wall if wall > 0 else 0.0}
+
+
+def latency_histogram(latencies: list) -> dict:
+    """Client-observed latency histogram over the SAME fixed buckets —
+    and the same bucketing rule (``metrics_live.cumulative_buckets``) —
+    as the server's live registry (ISSUE 10), so the two sides line up
+    1:1: ``{"le_<bound>": cumulative count}``."""
+    out = {f"le_{b:g}": cum for b, cum in metrics_live.cumulative_buckets(
+        latencies, metrics_live.LATENCY_BUCKETS_S)}
+    out["le_inf"] = len(latencies)
+    return out
+
+
+def reconcile_with_server(prom_text: str, statuses: dict) -> list[str]:
+    """The dropped-reply catcher (ISSUE 10 satellite): the server's
+    ``sort_serve_requests_total`` MUST equal the client's own reply
+    accounting — a silently dropped reply shows up as a server-side
+    request with no client-side status.  Also validates the exposition
+    format and that every exported name is registered.  Returns a list
+    of failures (empty = reconciled)."""
+    errs = metrics_live.check_exposition(prom_text)
+    try:
+        fams = metrics_live.parse_prom_text(prom_text)
+    except ValueError as e:
+        return errs + [f"/metrics unparseable: {e}"]
+    reqs = fams.get("sort_serve_requests_total")
+    server_total = int(sum(v for _n, _l, v in reqs["samples"])) \
+        if reqs else 0
+    # a transport-level client error usually means no server reply —
+    # but the server counts a request in _finish BEFORE writing the
+    # reply bytes, so a connection dropped mid-reply is counted
+    # server-side while the client files it under client_error.  Exact
+    # equality is therefore required only against the clean count; each
+    # client_error may or may not have a server-side twin.
+    client_clean = sum(v for k, v in statuses.items()
+                       if not k.startswith("client_error:"))
+    client_errors = sum(v for k, v in statuses.items()
+                        if k.startswith("client_error:"))
+    if not client_clean <= server_total <= client_clean + client_errors:
+        errs.append(
+            f"request-count reconciliation failed: server counted "
+            f"{server_total}, client observed {client_clean} clean "
+            f"(+{client_errors} transport errors; statuses {statuses}) "
+            "— replies were dropped or double-counted")
+    return errs
 
 
 def phase_stats(name: str, st: dict) -> None:
@@ -274,7 +358,13 @@ def measure_phase(out: Path, tag: str, window_ms: str, requests: int,
     measured phase; returns (stats, measured-window spans, server rc).
     The default ``SORT_SERVE_SHAPE_BUCKETS`` prewarm covers every
     bucket the packed path can request, so the warm-cache gate holds
-    with a default-config server."""
+    with a default-config server.
+
+    Before shutdown the server's live ``/metrics`` endpoint is scraped
+    (ISSUE 10): exposition validated, server-side request count
+    reconciled against the client's own accounting over BOTH phases
+    (warmup + measured) — failures land in ``stats["metrics_errors"]``
+    and fail the selftest leg."""
     srv = Server(out, tag, {
         "SORT_SERVE_BATCH_WINDOW_MS": window_ms,
     })
@@ -286,6 +376,16 @@ def measure_phase(out: Path, tag: str, window_ms: str, requests: int,
         stats = run_load(srv.port, requests, concurrency, seed)
         phase_stats(tag, stats)
         spans = srv.spans_after(cut)
+        combined = dict(warm["statuses"])
+        for k, v in stats["statuses"].items():
+            combined[k] = combined.get(k, 0) + v
+        try:
+            prom = srv.scrape_metrics()
+        except OSError as e:
+            stats["metrics_errors"] = [f"/metrics scrape failed: {e}"]
+        else:
+            stats["metrics_errors"] = reconcile_with_server(prom, combined)
+            (out / f"metrics_{tag}.prom").write_text(prom)
     finally:
         rc = srv.stop()
     return stats, spans, rc
@@ -315,6 +415,9 @@ def emit_row(stats: dict, extra: dict) -> dict:
         "p99_ms": round(percentile(lat, 99) * 1e3, 3),
         "requests": sum(stats["statuses"].values()),
         "keys": stats["keys"],
+        # client-observed latency histogram (same buckets as the
+        # server's live registry — the two sides line up 1:1)
+        "latency_hist": stats.get("latency_hist"),
         **extra,
     }
     print(json.dumps(row), flush=True)
@@ -360,6 +463,8 @@ def check_leg(tag: str, stats: dict, rc: int, requests: int,
         fails.append(f"{tag}: request accounting mismatch: "
                      f"{sum(stats['statuses'].values())} recorded of "
                      f"{requests} claimed")
+    for e in stats.get("metrics_errors", []):
+        fails.append(f"{tag}: {e}")
 
 
 def selftest(out: Path, requests: int, concurrency: int, seed: int) -> int:
@@ -517,6 +622,10 @@ def main() -> int:
     if stats["bad_parity"] or set(stats["statuses"]) != {"ok"}:
         log(f"load errors: {stats['statuses']} "
             f"bad_parity={stats['bad_parity']}")
+        return 1
+    if stats.get("metrics_errors"):
+        for e in stats["metrics_errors"]:
+            log(f"[FAIL] {e}")
         return 1
     emit_row(stats, {"concurrency": args.concurrency,
                      "dispatch_mkeys_per_s":

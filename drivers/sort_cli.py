@@ -130,6 +130,11 @@ def main(argv: list[str] | None = None) -> int:
             "SORT_FAULTS", "SORT_FAULTS_SEED", "SORT_LOCAL_ENGINE",
             "SORT_DEVICES", "SORT_NEGOTIATE", "SORT_RESTAGE",
             "SORT_RESTAGE_RATIO",
+            # live-telemetry knobs (ISSUE 10): the span sampler runs in
+            # every SpanLog and the flight recorder dumps on typed
+            # errors, so garbage dies here, not mid-sort
+            "SORT_TRACE_SAMPLE", "SORT_FLIGHT_RECORDER_SIZE",
+            "SORT_FLIGHT_RECORDER_DIR",
         )
         # resolve the encode engine NOW: SORT_NATIVE_ENCODE=on with no
         # usable libencode.so is one clean [ERROR] line here, never a

@@ -32,6 +32,16 @@ Telemetry: ``SORT_TRACE=<path>`` streams every ``serve.request`` /
 ``serve.batch`` / ``serve.compile_cache`` span (plus all the ordinary
 sort spans) as JSONL; ``python -m mpitest_tpu.report`` renders the
 p50/p99 SLO table from exactly that stream.
+
+Live telemetry (ISSUE 10): a second stdout line ``sort_server metrics
+on HOST:PORT`` names the side port (``SORT_METRICS_PORT``; -1 disables)
+serving ``/metrics`` (Prometheus text), ``/healthz``, ``/varz``,
+``/flightrecorder`` (the in-memory span ring; ``?dump=1`` writes an
+artifact) and ``/profile?n=K`` (jax.profiler capture of the next K
+dispatches).  Every request carries a ``trace_id`` (client-minted or
+server-minted, echoed in the response) stamped on every span it
+touches; ``SIGQUIT`` dumps the flight recorder WITHOUT shutting down;
+``SORT_TRACE_SAMPLE`` down-samples the full JSONL stream under load.
 """
 
 from __future__ import annotations
@@ -63,11 +73,16 @@ def main(argv: list[str] | None = None) -> int:
     try:
         host = knobs.get("SORT_SERVE_HOST")
         port = knobs.get("SORT_SERVE_PORT")
+        metrics_port = knobs.get("SORT_METRICS_PORT")
         knobs.validate(
             "SORT_SERVE_MAX_INFLIGHT", "SORT_SERVE_MAX_BYTES",
             "SORT_SERVE_BATCH_WINDOW_MS", "SORT_SERVE_BATCH_KEYS",
             "SORT_SERVE_SHAPE_BUCKETS", "SORT_SERVE_PREWARM",
             "SORT_SERVE_ALLOW_FAULTS",
+            # the live-telemetry layer (ISSUE 10)
+            "SORT_TRACE_SAMPLE", "SORT_FLIGHT_RECORDER_SIZE",
+            "SORT_FLIGHT_RECORDER_DIR", "SORT_PROFILE",
+            "SORT_PROFILE_EVERY",
             # the sort knobs every dispatch consumes
             "SORT_ALGO", "SORT_DTYPE", "SORT_DEVICES", "SORT_RANKS",
             "SORT_VERIFY", "SORT_MAX_RETRIES", "SORT_RETRY_BACKOFF",
@@ -94,6 +109,19 @@ def main(argv: list[str] | None = None) -> int:
     except OSError as e:
         err(f"cannot bind {host}:{port}: {e}")
         return 1
+    # Live-telemetry side port (ISSUE 10): /metrics, /healthz, /varz,
+    # /flightrecorder, /profile.  -1 disables; 0 = ephemeral.
+    telemetry = None
+    if metrics_port >= 0:
+        from mpitest_tpu.serve.telemetry import TelemetryServer
+
+        try:
+            telemetry = TelemetryServer(core, host, metrics_port)
+            telemetry.start()
+        except OSError as e:
+            err(f"cannot bind metrics port {host}:{metrics_port}: {e}")
+            server.server_close()
+            return 1
     stop = threading.Event()
 
     def on_signal(signum: int, frame: object) -> None:
@@ -102,20 +130,37 @@ def main(argv: list[str] | None = None) -> int:
         core.start_drain()
         stop.set()
 
+    def on_sigquit(signum: int, frame: object) -> None:
+        # incident snapshot, NOT shutdown: dump the flight-recorder
+        # ring and keep serving (the operator's kill -QUIT at 3am).
+        from mpitest_tpu.utils import flight_recorder
+
+        path = flight_recorder.get().dump("sigquit")
+        log(f"SIGQUIT: flight recorder dumped to {path or '(nothing)'}")
+
     signal.signal(signal.SIGTERM, on_signal)
     signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGQUIT, on_sigquit)
 
     serve_thread = threading.Thread(target=server.serve_forever,
                                     name="serve-accept", daemon=True)
     serve_thread.start()
     # The sync line load generators / the selftest wait for (stdout, one
     # line, flushed — parse the real bound port from it when PORT=0).
+    # The metrics line follows SECOND so single-line readers keep
+    # working; scrapers read both.
     print(f"sort_server listening on {host}:{server.bound_port}",
           flush=True)
+    if telemetry is not None:
+        print(f"sort_server metrics on {host}:{telemetry.bound_port}",
+              flush=True)
     stop.wait()
     drained = core.drain_and_stop(timeout=60.0)
     server.shutdown()
     server.server_close()
+    if telemetry is not None:
+        telemetry.shutdown()
+        telemetry.server_close()
     log(f"drained={'clean' if drained else 'TIMEOUT'} "
         f"served_ok={core.requests_ok} errors={core.requests_err} "
         f"rejected={core.admission.rejected} "

@@ -923,10 +923,11 @@ def radix_pass_states(
         yield k, n, full
 
 
-def _device_mem_high_water(span: Any, mesh: Mesh | None) -> None:
-    """Attach the mesh devices' peak-HBM high-water to ``span`` where the
-    backend exposes ``memory_stats()`` (real TPU; CPU returns nothing).
-    Best-effort telemetry — never raises."""
+def device_mem_peak(mesh: Mesh | None) -> int:
+    """Peak HBM high-water across the mesh devices where the backend
+    exposes ``memory_stats()`` (real TPU; CPU returns 0).  Best-effort
+    telemetry — never raises.  The serve layer attaches this per packed
+    batch (ISSUE 10); :func:`sort` attaches it to its umbrella span."""
     try:
         devs = list(mesh.devices.flat) if mesh is not None else jax.devices()
         peak = 0
@@ -934,10 +935,16 @@ def _device_mem_high_water(span: Any, mesh: Mesh | None) -> None:
             stats = d.memory_stats() if hasattr(d, "memory_stats") else None
             if stats:
                 peak = max(peak, int(stats.get("peak_bytes_in_use", 0)))
-        if peak:
-            span.attrs["device_mem_peak_bytes"] = peak
+        return peak
     except Exception:
-        pass
+        return 0
+
+
+def _device_mem_high_water(span: Any, mesh: Mesh | None) -> None:
+    """Attach :func:`device_mem_peak` to ``span`` when nonzero."""
+    peak = device_mem_peak(mesh)
+    if peak:
+        span.attrs["device_mem_peak_bytes"] = peak
 
 
 def ingest_to_mesh(
@@ -1018,8 +1025,18 @@ def sort(
         n=int(size) if size is not None else None,
         dtype=str(getattr(x, "dtype", "")) or None,
     ) as sp, faults.active(reg):
-        out = _sort_impl(x, algorithm, mesh, digit_bits, cap_factor,
-                         oversample, tracer, return_result, pack, reg)
+        try:
+            out = _sort_impl(x, algorithm, mesh, digit_bits, cap_factor,
+                             oversample, tracer, return_result, pack, reg)
+        except supervision.SortFaultError as e:
+            # ISSUE 10: a typed terminal error leaves an artifact — the
+            # flight recorder's last-N spans (this run's retries, fault
+            # events and failed verifications included) dumped where
+            # SORT_FLIGHT_RECORDER_DIR points, rate-limited per reason.
+            from mpitest_tpu.utils import flight_recorder
+
+            flight_recorder.dump_on_error(type(e).__name__)
+            raise
         _device_mem_high_water(sp, mesh)
     return out
 

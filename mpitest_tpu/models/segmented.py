@@ -174,6 +174,36 @@ def compile_packed_sort(n_words_total: int,
     return jax.jit(f).lower(*specs).compile()
 
 
+def executable_stats(exe: Any) -> dict[str, float]:
+    """XLA cost/compile statistics of an AOT-compiled executable —
+    flops, bytes accessed, generated code size — recorded into the
+    ``serve.compile_cache`` miss event at compile time (ISSUE 10
+    device profiling hook).  Every probe is best-effort: the
+    cost-analysis surface varies across jax versions and backends, and
+    telemetry must never fail a compile that succeeded."""
+    out: dict[str, float] = {}
+    try:
+        ca = exe.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if isinstance(ca, dict):
+            for src, dst in (("flops", "flops"),
+                             ("bytes accessed", "bytes_accessed")):
+                v = ca.get(src)
+                if v is not None and float(v) >= 0:
+                    out[dst] = float(v)
+    except Exception:  # noqa: BLE001 — version-dependent surface
+        pass
+    try:
+        ma = exe.memory_analysis()
+        v = getattr(ma, "generated_code_size_in_bytes", None)
+        if v is not None:
+            out["code_bytes"] = float(v)
+    except Exception:  # noqa: BLE001
+        pass
+    return out
+
+
 def run_packed(batch: PackedBatch,
                executable: Callable[..., Any] | None = None,
                ) -> tuple[np.ndarray, ...]:

@@ -109,6 +109,12 @@ def wire_registry(reg: flt.FaultRegistry | None,
         tracer.spans.record("fault", time.perf_counter(), 0.0,
                             site=site, **{k: v for k, v in detail.items()
                                           if k != "word"})
+        # ISSUE 10: a firing fault site is an incident trigger — dump
+        # the flight-recorder ring (rate-limited per site, so a chaos
+        # grid documents each site once, not once per cell).
+        from mpitest_tpu.utils import flight_recorder
+
+        flight_recorder.dump_on_error(f"fault_{site}")
 
     reg.on_fire = _on_fault
 

@@ -42,13 +42,17 @@ import sys
 from pathlib import Path
 
 from mpitest_tpu.utils import span_schema
-from mpitest_tpu.utils.span_schema import (BALANCE_SPAN, FAULT_SPAN,
+from mpitest_tpu.utils.span_schema import (BALANCE_SPAN,
+                                           BATCH_ID_ATTR,
+                                           BATCH_TRACE_IDS_ATTR,
+                                           FAULT_SPAN,
                                            INGEST_HOST_STAGES,
                                            INGEST_XFER_STAGES, PHASE_PREFIX,
                                            RESTAGE_SPAN, RETRY_SPAN,
                                            SERVE_BATCH_SPAN,
                                            SERVE_CACHE_SPAN,
-                                           SERVE_REQUEST_SPAN, VERIFY_SPAN)
+                                           SERVE_REQUEST_SPAN,
+                                           TRACE_ID_ATTR, VERIFY_SPAN)
 from mpitest_tpu.utils.spans import (MPI_EQUIV, SCHEMA as SPAN_SCHEMA,
                                      merge_intervals, overlap_seconds)
 
@@ -60,6 +64,10 @@ COMM_STATS_SCHEMA = "comm_stats.v1"
 #: ``--require-ingest-overlap`` re-checks it from the recorded
 #: ``ingest_ratio`` metric when one is present.
 INGEST_RATIO_GATE = 0.5
+
+#: Default availability SLO target for the error-budget line (ISSUE 10):
+#: at 99.9%, an 0.1% error rate burns the budget at exactly 1.0x.
+DEFAULT_SLO_TARGET_PCT = 99.9
 
 
 # --------------------------------------------------------------- loading
@@ -327,11 +335,29 @@ def percentile(sorted_values: list, q: float) -> float:
     return float(sorted_values[rank - 1])
 
 
-def serve_slo(serve: dict) -> dict | None:
+def error_budget(requests: int, errors: int,
+                 target_pct: float = DEFAULT_SLO_TARGET_PCT) -> dict:
+    """Error-budget / burn-rate arithmetic (ISSUE 10), shared by the
+    span-derived SLO table and the ``--prom`` snapshot view: the budget
+    is ``100 - target_pct`` percent of requests; burn is the measured
+    error rate over that allowance (1.0x = exactly on budget)."""
+    rate = 100.0 * errors / requests if requests else 0.0
+    allowance = 100.0 - target_pct
+    return {
+        "slo_target_pct": target_pct,
+        "error_rate_pct": round(rate, 4),
+        "budget_burn": (round(rate / allowance, 2) if allowance > 0
+                        else None),
+    }
+
+
+def serve_slo(serve: dict,
+              slo_target: float = DEFAULT_SLO_TARGET_PCT) -> dict | None:
     """Fold the serve.* span census into the SLO table (ISSUE 8):
     p50/p99/mean request latency over SUCCESSFUL requests (an error is
     an error budget line, not a latency sample), error counts by typed
-    code, the batched fraction, and the executor-cache hit ratio.
+    code, the batched fraction, the executor-cache hit ratio, and the
+    error-budget burn against ``slo_target`` (ISSUE 10).
     None when no serve activity was recorded."""
     reqs = serve.get("requests", [])
     if not reqs and not serve.get("batches") \
@@ -356,7 +382,126 @@ def serve_slo(serve: dict) -> dict | None:
         "cache_misses": serve.get("cache_misses", 0),
         "compile_s": round(serve.get("compile_s", 0.0), 4),
     }
+    out.update(error_budget(len(reqs), len(reqs) - len(ok), slo_target))
     return out
+
+
+# ----------------------------------------------------- trace view (live)
+
+def trace_view(rows: list[dict], trace_id: str) -> str | None:
+    """Reconstruct ONE request end-to-end from its ``trace_id`` (ISSUE
+    10): its ``serve.request`` span (queue wait, status, latency), the
+    packed dispatch it shared (via ``batch_id`` — batchmates counted,
+    never leaked), and every dispatch-side span stamped with either id
+    (the ``sort`` umbrella, phases, retries, faults, verifications),
+    rendered as one chronological timeline.  None when no span carries
+    the id."""
+    spans = [r for r in rows if r.get("kind") == "span"]
+    direct = [s for s in spans
+              if s.get("attrs", {}).get(TRACE_ID_ATTR) == trace_id]
+    batch_ids = {s["attrs"][BATCH_ID_ATTR] for s in direct
+                 if s.get("attrs", {}).get(BATCH_ID_ATTR) is not None}
+    batchmates: set[str] = set()
+    for s in spans:
+        if s.get("name") == SERVE_BATCH_SPAN:
+            tids = s.get("attrs", {}).get(BATCH_TRACE_IDS_ATTR) or []
+            if trace_id in tids:
+                bid = s["attrs"].get(BATCH_ID_ATTR)
+                if bid is not None:
+                    batch_ids.add(bid)
+                batchmates.update(t for t in tids if t != trace_id)
+    direct_keys = {(s.get("_path"), s.get("pid"), s.get("id"))
+                   for s in direct}
+    related = [
+        s for s in spans
+        if (s.get("_path"), s.get("pid"), s.get("id")) not in direct_keys
+        and s.get("attrs", {}).get(BATCH_ID_ATTR) in batch_ids
+        # a batchmate's own serve.request carries ITS trace_id — that
+        # is someone else's request, not part of this timeline
+        and s.get("attrs", {}).get(TRACE_ID_ATTR) in (None, trace_id)
+    ]
+    selected = direct + related
+    if not selected:
+        return None
+    selected.sort(key=lambda s: (str(s.get("_path")), s.get("pid"),
+                                 float(s.get("t0", 0.0))))
+    t_base = min(float(s.get("t0", 0.0)) for s in selected)
+    req = next((s for s in direct if s.get("name") == SERVE_REQUEST_SPAN),
+               None)
+    out = [f"request trace {trace_id}"]
+    if req is not None:
+        a = req.get("attrs", {})
+        line = (f"  status={a.get('status')} n={a.get('n')} "
+                f"dtype={a.get('dtype')} "
+                f"latency={float(req.get('dt', 0.0)) * 1e3:.3f}ms")
+        if a.get("queue_s") is not None:
+            line += f" queue_wait={float(a['queue_s']) * 1e3:.3f}ms"
+        if a.get(BATCH_ID_ATTR):
+            line += (f" batch={a[BATCH_ID_ATTR]} "
+                     f"(+{len(batchmates)} batchmate(s), "
+                     f"bucket={a.get('bucket')})")
+        else:
+            line += " batched=" + str(bool(a.get("batched")))
+        out.append(line)
+    out.append(f"  {'t+ms':>10} {'dur ms':>10} {'span':<20} attrs")
+    hidden = ("trace_id", "batch_id", "trace_ids")
+    for s in selected:
+        a = {k: v for k, v in s.get("attrs", {}).items()
+             if k not in hidden and not isinstance(v, list)}
+        attr_txt = " ".join(f"{k}={v}" for k, v in sorted(a.items()))
+        out.append(
+            f"  {(float(s.get('t0', 0.0)) - t_base) * 1e3:>10.3f} "
+            f"{float(s.get('dt', 0.0)) * 1e3:>10.3f} "
+            f"{s.get('name', '?'):<20} {attr_txt}"[:120])
+    return "\n".join(out)
+
+
+# --------------------------------------------- live metrics snapshots
+
+def render_prom_snapshot(path: str, text: str,
+                         slo_target: float = DEFAULT_SLO_TARGET_PCT,
+                         ) -> str:
+    """Render a scraped ``/metrics`` snapshot (Prometheus text) beside
+    the span-derived tables — the "live mode" for state sampled from a
+    RUNNING server instead of a finished trace file.  Includes the
+    error-budget line computed from the request counters."""
+    from mpitest_tpu.utils.metrics_live import parse_prom_text
+
+    fams = parse_prom_text(text)
+    out = [f"live metrics snapshot ({path})"]
+    reqs = fams.get("sort_serve_requests_total")
+    if reqs:
+        by_status = {lbl.get("status", "?"): v
+                     for name, lbl, v in reqs["samples"]}
+        total = int(sum(by_status.values()))
+        errs = int(sum(v for k, v in by_status.items() if k != "ok"))
+        out.append("  requests " + ", ".join(
+            f"{k}={int(v)}" for k, v in sorted(by_status.items())))
+        eb = error_budget(total, errs, slo_target)
+        burn = eb["budget_burn"]
+        out.append(
+            f"  error budget ({eb['slo_target_pct']}% target): "
+            f"{eb['error_rate_pct']}% errors"
+            + (f" -> burn {burn}x" if burn is not None else ""))
+    lat = fams.get("sort_serve_request_latency_seconds")
+    if lat:
+        plain = {n: v for n, lbl, v in lat["samples"] if not lbl}
+        cnt = plain.get("sort_serve_request_latency_seconds_count", 0)
+        tot = plain.get("sort_serve_request_latency_seconds_sum", 0.0)
+        if cnt:
+            out.append(f"  latency mean {1e3 * tot / cnt:.3f} ms "
+                       f"over {int(cnt)} request(s)")
+    for name, label in (("sort_serve_inflight", "in flight"),
+                        ("sort_serve_cache_hits_total", "cache hits"),
+                        ("sort_serve_cache_misses_total", "cache misses"),
+                        ("sort_retries_total", "dispatch retries"),
+                        ("sort_verify_failures_total", "verify failures")):
+        fam = fams.get(name)
+        if fam and fam["samples"]:
+            v = sum(v for _n, _l, v in fam["samples"])
+            out.append(f"  {label}: {int(v)}")
+    out.append(f"  families: {len(fams)}")
+    return "\n".join(out)
 
 
 # ------------------------------------------------------------ regression
@@ -469,7 +614,7 @@ def _fmt_bytes(b: float) -> str:
     return f"{b:.1f}GiB"
 
 
-def render(agg: dict) -> str:
+def render(agg: dict, slo_target: float = DEFAULT_SLO_TARGET_PCT) -> str:
     out = []
     if agg["phases"]:
         out.append("per-phase wall time")
@@ -544,7 +689,7 @@ def render(agg: dict) -> str:
             out.append(line)
         if so.get("restages"):
             out.append(f"  skew re-stages: {so['restages']}")
-    slo = serve_slo(agg.get("serve") or {})
+    slo = serve_slo(agg.get("serve") or {}, slo_target)
     if slo:
         out.append("")
         out.append("sort-as-a-service (serve.* spans — request latency SLO)")
@@ -555,6 +700,12 @@ def render(agg: dict) -> str:
                       if slo["errors"] else ""))
         out.append(f"  latency p50 {slo['p50_ms']} ms, "
                    f"p99 {slo['p99_ms']} ms, mean {slo['mean_ms']} ms")
+        if slo["requests"]:
+            burn = slo["budget_burn"]
+            out.append(
+                f"  error budget ({slo['slo_target_pct']}% target): "
+                f"{slo['error_rate_pct']}% errors"
+                + (f" -> burn {burn}x" if burn is not None else ""))
         if slo["batches"]:
             segs = slo["batch_segments"] / slo["batches"]
             out.append(f"  batches {slo['batches']} "
@@ -622,10 +773,26 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--threshold", type=float, default=0.9,
                     help="regression threshold: flag when current < "
                          "THRESHOLD * pinned (default 0.9)")
+    ap.add_argument("--trace-id",
+                    help="live mode (ISSUE 10): reconstruct ONE request "
+                         "end-to-end from its trace id — queue wait, "
+                         "batch membership, dispatch, verify and reply "
+                         "spans as a timeline; exit 1 when no span "
+                         "carries the id")
+    ap.add_argument("--prom", action="append", default=[],
+                    metavar="FILE",
+                    help="live mode: render a scraped /metrics snapshot "
+                         "(Prometheus text exposition) beside the tables, "
+                         "including the error-budget line")
+    ap.add_argument("--slo-target", type=float,
+                    default=DEFAULT_SLO_TARGET_PCT,
+                    help="availability target (%%) the error-budget/"
+                         "burn-rate line is computed against "
+                         f"(default {DEFAULT_SLO_TARGET_PCT})")
     args = ap.parse_args(argv)
 
     files = list(args.files)
-    if not files:
+    if not files and not args.prom:
         default = Path("bench/BASELINE_RESULTS.jsonl")
         if default.exists():
             files = [str(default)]
@@ -639,6 +806,15 @@ def main(argv: list[str] | None = None) -> int:
         except OSError as e:
             print(f"[ERROR] {f}: {e}", file=sys.stderr)
             return 1
+
+    if args.trace_id is not None:
+        view = trace_view(rows, args.trace_id)
+        if view is None:
+            print(f"[ERROR] no span carries trace_id {args.trace_id!r} "
+                  f"across {len(files)} file(s)", file=sys.stderr)
+            return 1
+        print(view)
+        return 0
 
     # each gate runs standalone — --require-registered-spans without
     # --check must still check (a gate that silently skips is worse
@@ -687,7 +863,19 @@ def main(argv: list[str] | None = None) -> int:
                       "half the raw sort throughput)", file=sys.stderr)
                 return 1
             print(f"ingest ratio OK: {ratio} >= {INGEST_RATIO_GATE}")
-    print(render(agg))
+    print(render(agg, args.slo_target))
+    for prom_file in args.prom:
+        try:
+            text = Path(prom_file).read_text()
+        except OSError as e:
+            print(f"[ERROR] {prom_file}: {e}", file=sys.stderr)
+            return 1
+        try:
+            print("\n" + render_prom_snapshot(prom_file, text,
+                                              args.slo_target))
+        except ValueError as e:
+            print(f"[ERROR] {prom_file}: {e}", file=sys.stderr)
+            return 1
 
     if args.baseline:
         from mpitest_tpu.utils.platform import host_fingerprint

@@ -40,8 +40,19 @@ class AdmissionControl:
         self.rejected = 0
         self.admitted = 0
         self.draining = False
+        #: observer called with (inflight, inflight_bytes) UNDER the
+        #: admission lock on every admit/release — the server points it
+        #: at the live in-flight gauges (ISSUE 10).  Publishing inside
+        #: the lock means gauge writes are ordered exactly like the
+        #: state changes; a read-then-set outside it could leave a
+        #: phantom in-flight count exported forever on an idle server.
+        self.on_change = None
         self._lock = threading.Lock()
         self._idle = threading.Condition(self._lock)
+
+    def _changed(self) -> None:
+        if self.on_change is not None:
+            self.on_change(self.inflight, self.inflight_bytes)
 
     def admit(self, nbytes: int) -> None:
         with self._lock:
@@ -65,13 +76,26 @@ class AdmissionControl:
             self.inflight += 1
             self.inflight_bytes += nbytes
             self.admitted += 1
+            self._changed()
 
     def release(self, nbytes: int) -> None:
         with self._lock:
             self.inflight -= 1
             self.inflight_bytes -= nbytes
+            self._changed()
             if self.inflight == 0:
                 self._idle.notify_all()
+
+    def snapshot(self) -> dict:
+        """Point-in-time state for the live /varz endpoint (ISSUE 10)."""
+        with self._lock:
+            return {"inflight": self.inflight,
+                    "inflight_bytes": self.inflight_bytes,
+                    "max_inflight": self.max_inflight,
+                    "max_bytes": self.max_bytes,
+                    "admitted": self.admitted,
+                    "rejected": self.rejected,
+                    "draining": self.draining}
 
     def start_drain(self) -> None:
         """Flip to draining: every subsequent admit is a typed

@@ -48,22 +48,36 @@ class ServeRequest:
     algo: str
     batchable: bool
     faults: str | None = None
+    #: wire/client-minted request trace id (ISSUE 10) — stamped on every
+    #: span this request touches via ``spans.trace_context``.
+    trace_id: str = ""
     t_enq: float = field(default_factory=time.perf_counter)
     done: threading.Event = field(default_factory=threading.Event)
     result: np.ndarray | None = None
     error: tuple[str, str] | None = None    # (code, detail)
     batched: bool = False
     bucket: int | None = None
+    #: packed-dispatch identity this request shared (None for solo).
+    batch_id: str | None = None
+    #: seconds between enqueue and dispatch pickup (the queue wait the
+    #: serve.request span + live histogram report).
+    queue_s: float | None = None
 
     @property
     def n(self) -> int:
         return int(self.arr.size)
 
+    def picked_up(self) -> None:
+        """Dispatch-thread pickup marker: fixes the queue wait."""
+        if self.queue_s is None:
+            self.queue_s = time.perf_counter() - self.t_enq
+
     def complete(self, out: np.ndarray, batched: bool,
-                 bucket: int | None) -> None:
+                 bucket: int | None, batch_id: str | None = None) -> None:
         self.result = out
         self.batched = batched
         self.bucket = bucket
+        self.batch_id = batch_id
         self.done.set()
 
     def fail(self, code: str, detail: str) -> None:

@@ -12,6 +12,7 @@ never raises on a *server-side* rejection, only on transport failure.
 from __future__ import annotations
 
 import json
+import os
 import socket
 from dataclasses import dataclass
 
@@ -38,6 +39,14 @@ class ServeReply:
     def detail(self) -> str:
         return str(self.header.get("detail", ""))
 
+    @property
+    def trace_id(self) -> str | None:
+        """The request's end-to-end trace id, echoed by the server
+        (ISSUE 10) — the key ``report.py --trace-id`` reconstructs the
+        request from."""
+        v = self.header.get("trace_id")
+        return str(v) if v is not None else None
+
 
 class ServeClient:
     """One persistent connection to a sort server."""
@@ -59,11 +68,16 @@ class ServeClient:
         self.close()
 
     def sort(self, arr: np.ndarray, algo: str | None = None,
-             faults: str | None = None) -> ServeReply:
-        """Send one sort request; block for the reply."""
+             faults: str | None = None,
+             trace_id: str | None = None) -> ServeReply:
+        """Send one sort request; block for the reply.  A ``trace_id``
+        is minted here when the caller supplies none — the client IS
+        the wire layer, so every request carries one end to end (the
+        server echoes it in the response header)."""
         arr = np.ascontiguousarray(arr).reshape(-1)
         hdr: dict = {"v": WIRE_SCHEMA, "dtype": arr.dtype.name,
-                     "n": int(arr.size)}
+                     "n": int(arr.size),
+                     "trace_id": trace_id or os.urandom(8).hex()}
         if algo is not None:
             hdr["algo"] = algo
         if faults is not None:

@@ -34,7 +34,8 @@ from dataclasses import dataclass, field
 from typing import TYPE_CHECKING, Any, Callable
 
 from mpitest_tpu.models.segmented import (MIN_BUCKET, bucket_for,
-                                          compile_packed_sort)
+                                          compile_packed_sort,
+                                          executable_stats)
 
 if TYPE_CHECKING:
     from mpitest_tpu.utils.spans import SpanLog
@@ -66,6 +67,17 @@ class ExecutorCache:
         self.stats = CacheStats()
         self.spans = spans
 
+    def snapshot(self) -> dict:
+        """Consistent point-in-time stats for the live /varz endpoint —
+        copied under the cache lock (iterating the live ``buckets`` set
+        while a miss mutates it would raise mid-scrape)."""
+        with self._lock:
+            return {"hits": self.stats.hits,
+                    "misses": self.stats.misses,
+                    "prewarmed": self.stats.prewarmed,
+                    "compile_s": round(self.stats.compile_s, 4),
+                    "buckets": sorted(self.stats.buckets)}
+
     # -- events -------------------------------------------------------
     def _event(self, **attrs: object) -> None:
         if self.spans is not None:
@@ -96,8 +108,12 @@ class ExecutorCache:
             self.stats.misses += 1
             self.stats.compile_s += dt
             self.stats.buckets.add(bucket)
+            # ISSUE 10: stamp the miss event with the XLA cost analysis
+            # (flops / bytes accessed / generated code size) so compile
+            # cost AND program cost are attributable per shape bucket
+            # straight from the span stream.
             self._event(hit=False, bucket=bucket, dtype=dtype_name,
-                        compile_s=round(dt, 6))
+                        compile_s=round(dt, 6), **executable_stats(exe))
             return exe
 
     # -- prewarm ------------------------------------------------------

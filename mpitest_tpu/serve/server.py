@@ -5,14 +5,16 @@ requests back to back):
 
 * request: one JSON header line (utf-8, ``\\n``-terminated) —
   ``{"v": "sortserve.v1", "dtype": "int32", "n": 4096}`` with optional
-  ``"algo"`` (radix | sample; solo dispatches only) and ``"faults"``
-  (a ``SORT_FAULTS`` spec, honored only when the server runs with
-  ``SORT_SERVE_ALLOW_FAULTS=1``) — followed by exactly
+  ``"algo"`` (radix | sample; solo dispatches only), ``"trace_id"``
+  (1-64 chars of ``[A-Za-z0-9_-]``; minted server-side when absent and
+  echoed in the response — the end-to-end request-trace key, ISSUE 10)
+  and ``"faults"`` (a ``SORT_FAULTS`` spec, honored only when the
+  server runs with ``SORT_SERVE_ALLOW_FAULTS=1``) — followed by exactly
   ``n * itemsize`` raw little-endian key bytes.
 * response: one JSON header line — ``{"ok": true, "n": ..., "batched":
-  ..., "bucket": ..., "latency_ms": ...}`` followed by the sorted key
-  bytes, or ``{"ok": false, "error": <code>, "detail": ...}`` with no
-  payload.  Error codes are TYPED and stable: ``bad_request`` (the
+  ..., "bucket": ..., "trace_id": ..., "batch_id": ...}`` followed by
+  the sorted key bytes, or ``{"ok": false, "error": <code>, "detail":
+  ..., "trace_id": ...}`` with no payload.  Error codes are TYPED and stable: ``bad_request`` (the
   header/payload is malformed), ``backpressure`` (admission bounds hit
   — retry with backoff), ``draining`` (SIGTERM received), ``integrity``
   (no path produced a verified result for THIS request),
@@ -40,6 +42,8 @@ ordinary ``SORT_TRACE`` stream.
 from __future__ import annotations
 
 import json
+import os
+import re
 import socketserver
 import threading
 import time
@@ -53,7 +57,10 @@ from mpitest_tpu.models import supervisor as supervision
 from mpitest_tpu.serve.admission import AdmissionControl, AdmissionReject
 from mpitest_tpu.serve.batching import Batcher, ServeRequest
 from mpitest_tpu.serve.executor_cache import ExecutorCache
-from mpitest_tpu.utils import knobs
+from mpitest_tpu.serve.telemetry import ProfileHook
+from mpitest_tpu.utils import flight_recorder, knobs
+from mpitest_tpu.utils import spans as spanlib
+from mpitest_tpu.utils.metrics_live import LiveMetrics, SpanMetricsBridge
 
 if TYPE_CHECKING:
     from jax.sharding import Mesh
@@ -80,6 +87,17 @@ MAX_REQUEST_KEYS = 1 << 31
 #: dispatcher bug — should be impossible) fails typed instead of
 #: hanging its connection forever.
 _COMPLETION_TIMEOUT_S = 600.0
+
+#: Wire-supplied trace ids: short, log/filename-safe tokens.  Anything
+#: else is a typed bad_request — trace ids land in span attrs, file
+#: names and report output, so the grammar is closed.
+_TRACE_ID_RE = re.compile(r"[A-Za-z0-9_\-]{1,64}")
+
+
+def mint_trace_id() -> str:
+    """Server-side trace id for requests that arrived without one (the
+    wire/client layer normally mints it — serve/client.py)."""
+    return os.urandom(8).hex()
 
 
 def _maybe_corrupt_packed(reg: "faults.FaultRegistry | None",
@@ -132,6 +150,19 @@ class ServerCore:
         self.admission = AdmissionControl(
             knobs.get("SORT_SERVE_MAX_INFLIGHT"),
             knobs.get("SORT_SERVE_MAX_BYTES"))
+        #: live metrics (ISSUE 10): the registry the /metrics endpoint
+        #: renders.  Span-derived metrics ride the span-close bridge;
+        #: only the admission gauges are written directly.
+        self.metrics = LiveMetrics()
+        self.tracer.spans.observers.append(SpanMetricsBridge(self.metrics))
+        #: on-demand jax.profiler captures around dispatches (ISSUE 10).
+        self.profiler = ProfileHook(self.tracer.spans)
+        # gauge publication rides the admission lock (see
+        # AdmissionControl.on_change) so exported in-flight counts can
+        # never be left stale by interleaved handler threads
+        self.admission.on_change = self._publish_admission
+        self.started = time.time()
+        self._batch_seq = 0
         self.batcher = Batcher(self._run_batch, self._run_solo,
                                window_ms / 1e3, self.batch_keys)
         self.requests_ok = 0
@@ -139,6 +170,10 @@ class ServerCore:
         #: guards the two tallies above — _finish runs on concurrent
         #: TCP handler threads, and a bare += loses increments.
         self._tally_lock = threading.Lock()
+
+    def _publish_admission(self, inflight: int, nbytes: int) -> None:
+        self.metrics.gauge("sort_serve_inflight").set(inflight)
+        self.metrics.gauge("sort_serve_inflight_bytes").set(nbytes)
 
     # -- startup ------------------------------------------------------
     def prewarm(self, log: Any = None) -> int:
@@ -155,21 +190,26 @@ class ServerCore:
     def _run_solo(self, req: ServeRequest) -> None:
         """One supervised sort for one request.  A per-request fault
         spec (test mode) installs a scoped registry — the dispatch
-        thread is single, so install/clear cannot race another sort."""
+        thread is single, so install/clear cannot race another sort.
+        Runs under the request's trace context: every span the sort
+        emits (phases, retries, faults, verify) carries its trace_id."""
         from mpitest_tpu.models import api
 
+        req.picked_up()
         reg = None
         if req.faults is not None:
             reg = faults.FaultRegistry(req.faults, seed=faults.faults_seed())
         try:
-            if reg is not None:
-                faults.install(reg)
-            try:
-                out = api.sort(req.arr, algorithm=req.algo, mesh=self.mesh,
-                               tracer=self.tracer)
-            finally:
+            with spanlib.trace_context(trace_id=req.trace_id), \
+                    self.profiler.maybe_capture():
                 if reg is not None:
-                    faults.install(None)
+                    faults.install(reg)
+                try:
+                    out = api.sort(req.arr, algorithm=req.algo,
+                                   mesh=self.mesh, tracer=self.tracer)
+                finally:
+                    if reg is not None:
+                        faults.install(None)
             req.complete(out, batched=False, bucket=None)
         except supervision.SortIntegrityError as e:
             req.fail(ERR_INTEGRITY, str(e))
@@ -178,48 +218,75 @@ class ServerCore:
         except (ValueError, TypeError, OverflowError) as e:
             req.fail(ERR_BAD_REQUEST, str(e))
         except Exception as e:  # noqa: BLE001 — one request's problem,
-            req.fail(ERR_INTERNAL, f"{type(e).__name__}: {e}")  # never the server's
+            # never the server's; an UNtyped failure is an incident the
+            # flight recorder must document (api.sort dumps the typed
+            # ones itself at their raise chokepoint)
+            flight_recorder.dump_on_error("serve_internal")
+            req.fail(ERR_INTERNAL, f"{type(e).__name__}: {e}")
 
     def _run_batch(self, reqs: "list[ServeRequest]") -> None:
         """One packed multi-tenant dispatch.  Per-segment verification
         isolates a bad segment: it re-runs solo under the supervisor,
-        its batchmates' verified results return normally."""
+        its batchmates' verified results return normally.  The whole
+        dispatch runs under a ``batch_id`` trace context, and the
+        ``serve.batch`` span lists every member's ``trace_id`` — one
+        request is reconstructable even when it shared a device sort
+        with strangers (ISSUE 10)."""
+        from mpitest_tpu.models import api
+
         t0 = time.perf_counter()
         dtype = reqs[0].dtype
-        try:
-            batch = segmented.pack_segments([r.arr for r in reqs], dtype)
-            exe = self.cache.get_packed(batch.bucket, dtype.name,
-                                        len(batch.words))
-            sorted_words = segmented.run_packed(batch, exe)
-            reg = faults.for_run()
-            supervision.wire_registry(reg, self.tracer)
-            sorted_words = _maybe_corrupt_packed(reg, sorted_words,
-                                                 batch.n_valid)
-            verdicts = segmented.verify_segments(batch, sorted_words)
-            outs = segmented.split_segments(batch, sorted_words)
-        except Exception as e:  # noqa: BLE001 — pack/dispatch died:
-            # nothing was verified; every tenant falls back to its own
-            # supervised solo run (typed per-request outcome)
-            self.tracer.count("serve_batch_fallbacks", 1)
-            self.tracer.verbose(f"batch dispatch failed "
-                                f"({type(e).__name__}: {e}); "
-                                "re-running each request solo")
-            for r in reqs:
-                self._run_solo(r)
-            return
-        self.tracer.spans.record(
-            "serve.batch", t0, time.perf_counter() - t0,
-            segments=len(reqs), keys=batch.n_valid, bucket=batch.bucket,
-            dtype=dtype.name)
-        for r, ok, out in zip(reqs, verdicts, outs):
-            if ok:
-                r.complete(out, batched=True, bucket=batch.bucket)
-            else:
-                self.tracer.count("serve_segment_requeues", 1)
-                self.tracer.verbose(
-                    "batched segment failed verification; re-running "
-                    "that request solo under the supervisor")
-                self._run_solo(r)
+        self._batch_seq += 1
+        batch_id = f"b{os.getpid():x}-{self._batch_seq:06x}"
+        for r in reqs:
+            r.picked_up()
+        with spanlib.trace_context(batch_id=batch_id):
+            try:
+                with self.profiler.maybe_capture():
+                    batch = segmented.pack_segments(
+                        [r.arr for r in reqs], dtype)
+                    exe = self.cache.get_packed(batch.bucket, dtype.name,
+                                                len(batch.words))
+                    sorted_words = segmented.run_packed(batch, exe)
+                reg = faults.for_run()
+                supervision.wire_registry(reg, self.tracer)
+                sorted_words = _maybe_corrupt_packed(reg, sorted_words,
+                                                     batch.n_valid)
+                verdicts = segmented.verify_segments(batch, sorted_words)
+                outs = segmented.split_segments(batch, sorted_words)
+            except Exception as e:  # noqa: BLE001 — pack/dispatch died:
+                # nothing was verified; every tenant falls back to its
+                # own supervised solo run (typed per-request outcome)
+                self.tracer.count("serve_batch_fallbacks", 1)
+                self.metrics.counter(
+                    "sort_serve_batch_fallbacks_total").inc(1)
+                flight_recorder.dump_on_error("serve_batch_fallback")
+                self.tracer.verbose(f"batch dispatch failed "
+                                    f"({type(e).__name__}: {e}); "
+                                    "re-running each request solo")
+                for r in reqs:
+                    self._run_solo(r)
+                return
+            attrs: dict = {"segments": len(reqs), "keys": batch.n_valid,
+                           "bucket": batch.bucket, "dtype": dtype.name,
+                           "trace_ids": [r.trace_id for r in reqs]}
+            peak = api.device_mem_peak(self.mesh)
+            if peak:
+                attrs["device_mem_peak_bytes"] = peak
+            self.tracer.spans.record(
+                "serve.batch", t0, time.perf_counter() - t0, **attrs)
+            for r, ok, out in zip(reqs, verdicts, outs):
+                if ok:
+                    r.complete(out, batched=True, bucket=batch.bucket,
+                               batch_id=batch_id)
+                else:
+                    self.tracer.count("serve_segment_requeues", 1)
+                    self.metrics.counter(
+                        "sort_serve_segment_requeues_total").inc(1)
+                    self.tracer.verbose(
+                        "batched segment failed verification; re-running "
+                        "that request solo under the supervisor")
+                    self._run_solo(r)
 
     # -- request execution (any handler thread) -----------------------
     def _finish(self, t0: float, attrs: dict, status: str,
@@ -243,6 +310,7 @@ class ServerCore:
 
     def _dispatch_admitted(self, t0: float, attrs: dict, arr: np.ndarray,
                            algo: str | None, faults_spec: str | None,
+                           trace_id: str,
                            ) -> tuple[str, Any, dict]:
         """Dispatch an ALREADY-ADMITTED request and wait for completion.
         The caller owns the admission release."""
@@ -251,7 +319,7 @@ class ServerCore:
             algo=algo or self.default_algo,
             batchable=(faults_spec is None
                        and int(arr.size) <= self.batch_keys),
-            faults=faults_spec)
+            faults=faults_spec, trace_id=trace_id)
         self.batcher.submit(req)
         if not req.done.wait(_COMPLETION_TIMEOUT_S):
             return self._finish(t0, attrs, ERR_INTERNAL,
@@ -259,21 +327,30 @@ class ServerCore:
         attrs["batched"] = req.batched
         if req.bucket is not None:
             attrs["bucket"] = req.bucket
+        if req.batch_id is not None:
+            attrs["batch_id"] = req.batch_id
+        if req.queue_s is not None:
+            attrs["queue_s"] = round(req.queue_s, 6)
         if req.error is not None:
             return self._finish(t0, attrs, req.error[0], req.error[1])
         return self._finish(t0, attrs, "ok", req.result)
 
     def execute(self, arr: np.ndarray, algo: str | None = None,
                 faults_spec: str | None = None,
+                trace_id: str | None = None,
                 ) -> tuple[str, Any, dict]:
         """Admit, dispatch and complete one request (the in-process
         entry; the wire path admits BEFORE materializing the payload —
         see :meth:`handle_wire`).  Returns ``(status, payload, attrs)``
         where status ``"ok"`` carries the sorted array and any error
-        status carries the detail string."""
+        status carries the detail string.  ``trace_id`` is minted when
+        the caller supplies none; it lands in ``attrs`` and on every
+        span the request touches."""
         t0 = time.perf_counter()
+        tid = trace_id or mint_trace_id()
         nbytes = int(arr.nbytes)
-        attrs: dict = {"n": int(arr.size), "dtype": str(arr.dtype)}
+        attrs: dict = {"n": int(arr.size), "dtype": str(arr.dtype),
+                       "trace_id": tid}
         try:
             self.admission.admit(nbytes)
         except AdmissionReject as e:
@@ -281,7 +358,7 @@ class ServerCore:
             return self._finish(t0, attrs, self.reject_code(e), str(e))
         try:
             return self._dispatch_admitted(t0, attrs, arr, algo,
-                                           faults_spec)
+                                           faults_spec, tid)
         finally:
             self.admission.release(nbytes)
 
@@ -310,10 +387,15 @@ class ServerCore:
         keep_alive)`` — ``keep_alive`` False means framing is lost
         (unreadable header / short payload) and the connection must
         close."""
+        tid: str | None = None   # echoed in every response once known
+
         def err(code: str, detail: str, keep: bool = True,
                 ) -> tuple[dict, bytes, bool]:
-            return ({"v": WIRE_SCHEMA, "ok": False, "error": code,
-                     "detail": detail}, b"", keep)
+            h = {"v": WIRE_SCHEMA, "ok": False, "error": code,
+                 "detail": detail}
+            if tid is not None:
+                h["trace_id"] = tid
+            return (h, b"", keep)
 
         try:
             hdr = json.loads(header_line.decode("utf-8"))
@@ -326,6 +408,17 @@ class ServerCore:
             return err(ERR_BAD_REQUEST,
                        f"unknown protocol version {hdr.get('v')!r} "
                        f"(want {WIRE_SCHEMA!r})", keep=False)
+        # trace context (ISSUE 10), parsed FIRST among the fields so
+        # every later typed error echoes it — a client correlating
+        # failures by its minted id must never lose one to a bad dtype.
+        raw_tid = hdr.get("trace_id")
+        if raw_tid is not None and (
+                not isinstance(raw_tid, str)
+                or not _TRACE_ID_RE.fullmatch(raw_tid)):
+            return err(ERR_BAD_REQUEST,
+                       f"bad trace_id {raw_tid!r} (1-64 chars of "
+                       "[A-Za-z0-9_-])", keep=False)
+        tid = raw_tid or mint_trace_id()
         try:
             dtype = np.dtype(str(hdr.get("dtype", "int32")))
             from mpitest_tpu.ops.keys import codec_for
@@ -363,7 +456,7 @@ class ServerCore:
         # request is drained in bounded chunks, so the in-flight byte
         # bound really bounds host memory, not just dispatch.
         t0 = time.perf_counter()
-        attrs: dict = {"n": n, "dtype": dtype.name}
+        attrs: dict = {"n": n, "dtype": dtype.name, "trace_id": tid}
         try:
             self.admission.admit(nbytes)
         except AdmissionReject as e:
@@ -385,7 +478,8 @@ class ServerCore:
             del payload
             status, result, attrs = self._dispatch_admitted(
                 t0, attrs, arr, algo,
-                str(faults_spec) if faults_spec is not None else None)
+                str(faults_spec) if faults_spec is not None else None,
+                tid)
         finally:
             self.admission.release(nbytes)
         if status != "ok":
@@ -393,7 +487,10 @@ class ServerCore:
         resp = {"v": WIRE_SCHEMA, "ok": True, "n": n,
                 "dtype": dtype.name,
                 "batched": bool(attrs.get("batched")),
-                "bucket": attrs.get("bucket")}
+                "bucket": attrs.get("bucket"),
+                "trace_id": tid}
+        if attrs.get("batch_id") is not None:
+            resp["batch_id"] = attrs["batch_id"]
         return resp, np.ascontiguousarray(result).tobytes(), True
 
     # -- lifecycle ----------------------------------------------------

@@ -1,0 +1,265 @@
+"""Live telemetry endpoints + device profiling hook for the sort server.
+
+The serving process must be observable WHILE it serves (ISSUE 10): a
+side-port HTTP server (``SORT_METRICS_PORT``) exposes
+
+* ``GET /metrics`` — Prometheus text exposition of the live registry
+  (``utils/metrics_live.py``): request/error/latency, queue wait, batch
+  occupancy, executor-cache hit/miss, verify overhead, retry/fault
+  counters, per-rank exchange-balance gauges;
+* ``GET /healthz`` — liveness JSON; HTTP 200 while serving, 503 once
+  draining (load balancers stop routing before SIGTERM finishes);
+* ``GET /varz`` — configuration + internal state: every explicitly-set
+  knob, the mesh, executor-cache/admission/batcher/flight-recorder
+  state;
+* ``GET /flightrecorder`` — the in-memory span ring as span-schema
+  JSONL (``?dump=1`` also writes a timestamped artifact to
+  ``SORT_FLIGHT_RECORDER_DIR`` and reports its path);
+* ``GET /profile?n=K`` — arm a ``jax.profiler`` capture for the next K
+  dispatches (Perfetto/TensorBoard-compatible trace into
+  ``SORT_PROFILE``, else ``<flight dir>/profile``).
+
+The handler threads only read shared state (one lock-cheap registry
+render, one deque snapshot) — a scrape can never block a dispatch.
+
+:class:`ProfileHook` is the dispatch-side half: endpoint-armed or
+every-Nth (``SORT_PROFILE_EVERY``) capture around exactly one dispatch,
+recorded as a ``serve.profile`` span event so captures are visible in
+the same stream as everything else.  jax.profiler failures degrade to a
+logged no-op — profiling must never fail a request.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import TYPE_CHECKING, Any, Iterator
+from urllib.parse import parse_qs, urlparse
+
+from mpitest_tpu.utils import flight_recorder, knobs
+from mpitest_tpu.utils.metrics_live import PROM_CONTENT_TYPE
+
+if TYPE_CHECKING:
+    from mpitest_tpu.serve.server import ServerCore
+    from mpitest_tpu.utils.spans import SpanLog
+
+
+class ProfileHook:
+    """Decides, per dispatch, whether to wrap it in a jax.profiler
+    capture; owns the armed-count (endpoint) and every-Nth
+    (``SORT_PROFILE_EVERY``) triggers."""
+
+    def __init__(self, spans: "SpanLog") -> None:
+        self.spans = spans
+        self.every = knobs.get("SORT_PROFILE_EVERY")
+        self.logdir = knobs.get("SORT_PROFILE") or os.path.join(
+            knobs.get("SORT_FLIGHT_RECORDER_DIR"), "profile")
+        self.captures = 0
+        self.failed = 0
+        self._armed = 0
+        self._seq = 0
+        self._lock = threading.Lock()
+
+    def arm(self, n: int = 1) -> int:
+        """Endpoint trigger: capture the next ``n`` dispatches."""
+        with self._lock:
+            self._armed += max(0, int(n))
+            return self._armed
+
+    def _should_capture(self) -> str | None:
+        with self._lock:
+            self._seq += 1
+            if self._armed > 0:
+                self._armed -= 1
+                return "endpoint"
+            if self.every and self._seq % self.every == 0:
+                return "every"
+        return None
+
+    @contextlib.contextmanager
+    def maybe_capture(self) -> Iterator[bool]:
+        """Wrap one dispatch; yields True when a capture is live."""
+        trigger = self._should_capture()
+        if trigger is None:
+            yield False
+            return
+        logdir = os.path.join(self.logdir, f"capture-{self._seq:05d}")
+        try:
+            import jax
+
+            os.makedirs(logdir, exist_ok=True)
+            jax.profiler.start_trace(logdir)
+        except Exception:  # noqa: BLE001 — profiling never fails a request
+            self.failed += 1
+            yield False
+            return
+        t0 = time.perf_counter()
+        try:
+            yield True
+        finally:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:  # noqa: BLE001
+                self.failed += 1
+            self.captures += 1
+            self.spans.record("serve.profile", t0,
+                              time.perf_counter() - t0,
+                              logdir=logdir, trigger=trigger,
+                              seq=self._seq)
+
+    def state(self) -> dict:
+        with self._lock:
+            return {"every": self.every, "logdir": self.logdir,
+                    "armed": self._armed, "captures": self.captures,
+                    "failed": self.failed}
+
+
+def _set_knobs() -> dict[str, str]:
+    """Every registered knob explicitly set in this process's
+    environment (raw values) — the /varz configuration view.  Defaults
+    are documented in README; varz shows what this server was told."""
+    out = {}
+    for k in knobs.iter_knobs():
+        raw = knobs.get_raw(k.name)
+        if raw is not None:
+            out[k.name] = raw
+    return out
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server: "TelemetryServer"  # type: ignore[assignment]
+
+    #: silence the default per-request stderr logging (a scrape every
+    #: few seconds would swamp the server log)
+    def log_message(self, format: str, *args: Any) -> None:  # noqa: A002
+        pass
+
+    def _reply(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _json(self, code: int, obj: object) -> None:
+        self._reply(code, (json.dumps(obj, indent=1) + "\n").encode(),
+                    "application/json")
+
+    def do_GET(self) -> None:  # noqa: N802 — http.server contract
+        try:
+            url = urlparse(self.path)
+            route = url.path.rstrip("/") or "/"
+            if route == "/metrics":
+                body = self.server.core.metrics.render_prom().encode()
+                self._reply(200, body, PROM_CONTENT_TYPE)
+            elif route == "/healthz":
+                self._healthz()
+            elif route == "/varz":
+                self._varz()
+            elif route == "/flightrecorder":
+                self._flightrecorder(parse_qs(url.query))
+            elif route == "/profile":
+                self._profile(parse_qs(url.query))
+            else:
+                self._json(404, {"error": f"unknown path {route!r}",
+                                 "routes": ["/metrics", "/healthz",
+                                            "/varz", "/flightrecorder",
+                                            "/profile"]})
+        except BrokenPipeError:
+            pass
+        except Exception as e:  # noqa: BLE001 — a scrape bug must not kill
+            try:                # the handler thread pool
+                self._json(500, {"error": f"{type(e).__name__}: {e}"})
+            except OSError:
+                pass
+
+    def _healthz(self) -> None:
+        core = self.server.core
+        draining = core.admission.draining
+        self._json(503 if draining else 200, {
+            "ok": not draining,
+            "draining": draining,
+            "uptime_s": round(time.time() - core.started, 3),
+            "inflight": core.admission.inflight,
+            "requests_ok": core.requests_ok,
+            "requests_err": core.requests_err,
+            "pid": os.getpid(),
+        })
+
+    def _varz(self) -> None:
+        core = self.server.core
+        mesh_devs = list(core.mesh.devices.flat)
+        rec = flight_recorder.get()
+        self._json(200, {
+            "knobs_set": _set_knobs(),
+            "mesh": {"devices": len(mesh_devs),
+                     "platform": mesh_devs[0].platform if mesh_devs
+                     else "?"},
+            "cache": core.cache.snapshot(),
+            "admission": core.admission.snapshot(),
+            "batcher": {"batches": core.batcher.batches,
+                        "batched_requests": core.batcher.batched_requests,
+                        "solo_requests": core.batcher.solo_requests,
+                        "window_s": core.batcher.window_s,
+                        "batch_keys": core.batcher.batch_keys},
+            "flight_recorder": {"capacity": rec.capacity,
+                                "recorded": rec.recorded,
+                                "dumps": rec.dumps,
+                                "dir": rec.directory},
+            "profiler": core.profiler.state(),
+            "requests": {"ok": core.requests_ok,
+                         "err": core.requests_err},
+            "uptime_s": round(time.time() - core.started, 3),
+        })
+
+    def _flightrecorder(self, query: dict) -> None:
+        rec = flight_recorder.get()
+        if query.get("dump", ["0"])[0] == "1":
+            path = rec.dump("endpoint")
+            self._json(200 if path else 409,
+                       {"dumped": path is not None, "path": path,
+                        "spans": len(rec.ring)})
+            return
+        body = "\n".join(json.dumps(d) for d in rec.snapshot())
+        self._reply(200, (body + "\n").encode() if body else b"",
+                    "application/jsonl")
+
+    def _profile(self, query: dict) -> None:
+        try:
+            n = int(query.get("n", ["1"])[0])
+        except ValueError:
+            self._json(400, {"error": "n must be an integer"})
+            return
+        if not 1 <= n <= 1000:
+            self._json(400, {"error": "n must be in [1, 1000]"})
+            return
+        armed = self.server.core.profiler.arm(n)
+        self._json(200, {"armed": armed,
+                         "logdir": self.server.core.profiler.logdir})
+
+
+class TelemetryServer(ThreadingHTTPServer):
+    """The side-port HTTP server.  Never binds the wire-protocol port;
+    ``SORT_METRICS_PORT=0`` picks an ephemeral port (printed by the
+    driver), ``-1`` disables construction entirely (the driver's
+    choice, not this class's)."""
+
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, core: "ServerCore", host: str, port: int) -> None:
+        super().__init__((host, port), _Handler)
+        self.core = core
+
+    @property
+    def bound_port(self) -> int:
+        return int(self.server_address[1])
+
+    def start(self) -> None:
+        t = threading.Thread(target=self.serve_forever,
+                             name="serve-telemetry", daemon=True)
+        t.start()

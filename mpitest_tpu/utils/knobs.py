@@ -367,6 +367,58 @@ register("SORT_PROFILE", "path", None, "a writable directory path",
          "Capture a jax.profiler trace of the sort into this logdir.",
          _passthrough)
 
+# Live-telemetry knobs (ISSUE 10): the operational layer — stream
+# sampling, the /metrics side port, the always-on flight recorder, and
+# the on-demand device profiling hooks.
+
+
+def _parse_sample(raw: str) -> float:
+    try:
+        v = float(raw)
+    except ValueError:
+        v = 0.0
+    if not (math.isfinite(v) and 0.0 < v <= 1.0):
+        raise KnobError(f"SORT_TRACE_SAMPLE={raw!r}: use a number in "
+                        "(0, 1]")
+    return v
+
+
+register("SORT_TRACE_SAMPLE", "float", 1.0, "a number in (0, 1]",
+         "Down-sample the SORT_TRACE stream: keep ~this fraction of "
+         "top-level spans (whole subtrees — parent links stay intact; "
+         "the flight recorder still sees everything).",
+         _parse_sample)
+
+
+def _parse_metrics_port(raw: str) -> int:
+    try:
+        v = int(raw)
+    except ValueError:
+        v = -2
+    if not -1 <= v <= 65535:
+        raise KnobError(f"SORT_METRICS_PORT={raw!r}: use an integer in "
+                        "[-1, 65535] (0 = ephemeral, -1 = disabled)")
+    return v
+
+
+register("SORT_METRICS_PORT", "int", 0, "an integer in [-1, 65535]",
+         "Side port for the server's live /metrics, /healthz, /varz, "
+         "/flightrecorder, /profile endpoints (0 = ephemeral, -1 = off).",
+         _parse_metrics_port)
+register("SORT_FLIGHT_RECORDER_SIZE", "int", 2048,
+         "an integer >= 0 (0 disables)",
+         "Flight-recorder ring capacity: recent spans kept in memory "
+         "for incident dumps (typed errors, faults, SIGQUIT).",
+         _int("SORT_FLIGHT_RECORDER_SIZE", lo=0))
+register("SORT_FLIGHT_RECORDER_DIR", "path", "/tmp/mpitest_flightrec",
+         "a writable directory path",
+         "Directory flight-recorder dump artifacts land in.",
+         _passthrough)
+register("SORT_PROFILE_EVERY", "int", 0, "an integer >= 0 (0 = off)",
+         "Capture a jax.profiler trace around every Nth server dispatch "
+         "(into SORT_PROFILE, else <flight dir>/profile).",
+         _int("SORT_PROFILE_EVERY", lo=0))
+
 # Streaming-ingest knobs (utils/io.py + models/ingest.py).
 
 register("SORT_INGEST", "enum", "auto", "auto | stream | mono",

@@ -72,7 +72,11 @@ SPAN_NAMES: dict[str, str] = {
     "serve.batch": ("one packed multi-tenant dispatch (segments, keys, "
                     "bucket)"),
     "serve.compile_cache": ("executor-cache lookup point event (hit, "
-                            "bucket, dtype; compile_s on miss)"),
+                            "bucket, dtype; compile_s + XLA cost "
+                            "analysis flops/bytes on miss)"),
+    "serve.profile": ("one on-demand jax.profiler capture (logdir, "
+                      "trigger=endpoint|every, seq) — ISSUE 10 device "
+                      "profiling hook"),
     # models/ingest.py — streamed pipeline stages (ISSUE 2)
     "ingest.parse": "parse/materialize one host chunk",
     "ingest.encode": "codec-encode one chunk (worker pool)",
@@ -100,6 +104,20 @@ RESTAGE_SPAN = "restage"
 SERVE_REQUEST_SPAN = "serve.request"
 SERVE_BATCH_SPAN = "serve.batch"
 SERVE_CACHE_SPAN = "serve.compile_cache"
+SERVE_PROFILE_SPAN = "serve.profile"
+
+#: Request-trace attributes (ISSUE 10): the wire layer mints one
+#: ``trace_id`` per request (echoed in the response) and the dispatch
+#: thread opens a ``spans.trace_context`` carrying it, so EVERY span a
+#: request touches — admission, batching, the ``sort`` umbrella and its
+#: phases, supervisor retries, fault events, verification — is stamped
+#: with the same id; packed dispatches additionally stamp the shared
+#: ``batch_id`` (and ``serve.batch`` lists every member's trace id
+#: under ``trace_ids``).  ``report.py --trace-id`` reconstructs one
+#: request end-to-end from exactly these attrs.
+TRACE_ID_ATTR = "trace_id"
+BATCH_ID_ATTR = "batch_id"
+BATCH_TRACE_IDS_ATTR = "trace_ids"
 
 
 def is_registered(name: str) -> bool:

@@ -56,7 +56,9 @@ import threading
 import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
-from typing import Iterator
+from typing import Callable, Iterator
+
+from mpitest_tpu.utils import knobs
 
 #: In-memory retention cap per SpanLog.  phases/counters accumulate by
 #: design across runs on a reused Tracer, but retaining every span of
@@ -126,6 +128,11 @@ class Span:
     t0: float               # seconds, process-relative (perf_counter)
     dt: float = 0.0
     attrs: dict[str, object] = field(default_factory=dict)
+    #: transient: excluded from the SORT_TRACE stream by the sampler
+    #: (SORT_TRACE_SAMPLE < 1); never serialized.  A root's verdict is
+    #: inherited by its whole subtree so parent links in the streamed
+    #: JSONL always resolve.
+    stream_drop: bool = field(default=False, repr=False, compare=False)
 
     def to_dict(self) -> dict[str, object]:
         # pid scopes the process-relative perf_counter timeline: rows
@@ -170,6 +177,52 @@ def maybe_span(
     return log.span(name, **attrs)
 
 
+#: Thread-local request/trace context (ISSUE 10): attributes merged
+#: into EVERY span the current thread creates while a context is open.
+#: This is how one serve request's ``trace_id`` (and its batch's
+#: ``batch_id``) reaches the ``sort`` umbrella, its phases, the
+#: supervisor's retry events and the verifier's verdicts WITHOUT
+#: plumbing an argument through every layer — the dispatch thread opens
+#: the context, everything it runs inherits the identity.
+_TRACE_CTX = threading.local()
+
+
+@contextmanager
+def trace_context(**attrs: object) -> Iterator[None]:
+    """Attach ``attrs`` (e.g. ``trace_id=...``, ``batch_id=...``) to
+    every span this thread creates inside the block.  Nests: inner
+    contexts merge over outer ones; explicit span attrs always win over
+    context attrs."""
+    prev: dict[str, object] | None = getattr(_TRACE_CTX, "attrs", None)
+    _TRACE_CTX.attrs = {**prev, **attrs} if prev else dict(attrs)
+    try:
+        yield
+    finally:
+        _TRACE_CTX.attrs = prev
+
+
+def current_trace_context() -> dict[str, object] | None:
+    """The attrs the current thread's open :func:`trace_context` would
+    stamp (None outside any context)."""
+    return getattr(_TRACE_CTX, "attrs", None)
+
+
+#: Lazily-bound flight-recorder hook (utils/flight_recorder.py): every
+#: completed span of every SpanLog lands in the process-wide ring.
+#: Bound on first flush so importing spans never drags the knob
+#: registry's env reads in at import time.
+_flight_record: "Callable[[Span], None] | None" = None
+
+
+def _flight(s: Span) -> None:
+    global _flight_record
+    if _flight_record is None:
+        from mpitest_tpu.utils import flight_recorder
+
+        _flight_record = flight_recorder.record
+    _flight_record(s)
+
+
 class SpanLog:
     """Accumulates nested spans; exports JSONL and Chrome trace-event.
 
@@ -182,8 +235,31 @@ class SpanLog:
         self.spans: list[Span] = []
         self.stream_path = stream_path
         self.dropped = 0       # spans past MAX_RETAINED_SPANS (streamed only)
+        #: observers called with every COMPLETED span (the span-close
+        #: path): the live-metrics bridge, tests.  Exceptions are
+        #: swallowed — telemetry may never take down the traced path.
+        self.observers: list[Callable[[Span], None]] = []
         self._stack: list[int] = []
+        self._drop_stack: list[bool] = []   # sampler verdicts, mirrors _stack
+        #: trace-context of each open span's OPENER thread (mirrors
+        #: _stack) — worker-thread record()s inherit the innermost one.
+        self._ctx_stack: list[dict[str, object] | None] = []
         self._next_id = 0
+        # SORT_TRACE_SAMPLE stream down-sampling: keep ~rate of the
+        # root spans (and each root's whole subtree — parent links in
+        # the streamed JSONL must resolve); retention, observers and
+        # the flight recorder always see everything.  Deterministic
+        # error-diffusion keep rule (a root is kept iff its index
+        # crosses an integer multiple of 1/rate), so EVERY rate in
+        # (0, 1) thins the stream by exactly that fraction long-run —
+        # a keep-every-Nth quantization would silently disable rates
+        # above 2/3.
+        try:
+            rate = float(knobs.get("SORT_TRACE_SAMPLE"))
+        except ValueError:
+            rate = 1.0
+        self._sample_rate = min(rate, 1.0)
+        self._sample_seq = 0
         #: guards id allocation, retention and streaming — the pieces
         #: pipeline worker threads share with the driver thread.  The
         #: nesting stack stays driver-thread-only by contract.
@@ -192,7 +268,17 @@ class SpanLog:
     # -- recording ----------------------------------------------------
     def _new(self, name: str, attrs: dict[str, object],
              t0: float | None = None, dt: float = 0.0) -> Span:
+        ctx = current_trace_context()
         with self._lock:
+            if ctx is None and self._ctx_stack:
+                # a worker thread (ingest/egress stages) reporting under
+                # the driver's innermost open span inherits THAT span's
+                # trace context — "every span a request touches" must
+                # include the pipeline stages its sort ran, even though
+                # trace_context itself is thread-local
+                ctx = self._ctx_stack[-1]
+            if ctx:
+                attrs = {**ctx, **attrs}
             s = Span(
                 name=name, id=self._next_id,
                 parent=self._stack[-1] if self._stack else None,
@@ -200,6 +286,17 @@ class SpanLog:
                 dt=dt, attrs=attrs,
             )
             self._next_id += 1
+            if self._sample_rate < 1.0:
+                if self._stack:
+                    # subtree follows its root's verdict
+                    s.stream_drop = (self._drop_stack[-1]
+                                     if self._drop_stack else False)
+                else:
+                    seq = self._sample_seq
+                    self._sample_seq += 1
+                    keep = (int((seq + 1) * self._sample_rate)
+                            != int(seq * self._sample_rate))
+                    s.stream_drop = not keep
         return s
 
     def _retain(self, s: Span) -> None:
@@ -234,15 +331,29 @@ class SpanLog:
         outermost span activates this log for module-level `emit()`."""
         s = self._new(name, attrs)
         self._retain(s)
-        self._stack.append(s.id)
-        outermost = len(self._stack) == 1
+        # stack mutations under the SAME lock _new reads them under:
+        # a worker-thread record() racing this push/pop must see the
+        # (parent id, drop verdict) PAIR consistently — a torn read
+        # could stream a kept span whose parent subtree was dropped
+        # (a dangling parent, which the schema check rejects).
+        opener_ctx = current_trace_context()
+        with self._lock:
+            if opener_ctx is None and self._ctx_stack:
+                opener_ctx = self._ctx_stack[-1]  # inherit downward
+            self._stack.append(s.id)
+            self._drop_stack.append(s.stream_drop)
+            self._ctx_stack.append(opener_ctx)
+            outermost = len(self._stack) == 1
         if outermost:
             _ACTIVE.append(self)
         try:
             yield s
         finally:
             s.dt = time.perf_counter() - s.t0
-            self._stack.pop()
+            with self._lock:
+                self._stack.pop()
+                self._drop_stack.pop()
+                self._ctx_stack.pop()
             if outermost and _ACTIVE and _ACTIVE[-1] is self:
                 _ACTIVE.pop()
             self._flush(s)
@@ -252,7 +363,13 @@ class SpanLog:
     _flush_lock = threading.Lock()
 
     def _flush(self, s: Span) -> None:
-        if self.stream_path:
+        _flight(s)
+        for cb in self.observers:
+            try:
+                cb(s)
+            except Exception:  # noqa: BLE001 — observers never break the path
+                pass
+        if self.stream_path and not s.stream_drop:
             with self._flush_lock, open(self.stream_path, "a") as f:
                 f.write(json.dumps(s.to_dict()) + "\n")
 

@@ -1,0 +1,546 @@
+"""Live-telemetry tests (ISSUE 10): the metrics registry + exposition
+format, the span-close bridge, SORT_TRACE_SAMPLE root-coherent
+sampling, trace-context propagation (solo / batched / retried / faulted
+requests all carry one trace_id end to end), the flight recorder's
+ring/dump contracts, report.py's live mode (--trace-id, error budget,
+--prom), the telemetry HTTP endpoints, and the bench-history table.
+
+In-process throughout (ServerCore + an ephemeral TelemetryServer); the
+subprocess wire drills live in ``make telemetry-selftest``."""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+from contextlib import contextmanager
+
+import numpy as np
+import pytest
+
+from mpitest_tpu import report
+from mpitest_tpu.utils import flight_recorder as fr
+from mpitest_tpu.utils import knobs, metrics_live
+from mpitest_tpu.utils.metrics_live import (LiveMetrics, SpanMetricsBridge,
+                                            check_exposition,
+                                            parse_prom_text)
+from mpitest_tpu.utils.spans import SpanLog, trace_context
+
+
+@contextmanager
+def serve_core(**env):
+    from mpitest_tpu.serve.server import ServerCore
+
+    with knobs.scoped_env(**env):
+        core = ServerCore()
+        try:
+            yield core
+        finally:
+            core.batcher.stop(timeout=10)
+
+
+# ------------------------------------------------------ metrics registry
+
+def test_counter_gauge_histogram_accuracy():
+    m = LiveMetrics()
+    c = m.counter("sort_serve_requests_total")
+    c.inc(1, status="ok")
+    c.inc(2, status="ok")
+    c.inc(1, status="integrity")
+    assert c.get(status="ok") == 3
+    assert c.total() == 4
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = m.gauge("sort_serve_inflight")
+    g.set(7)
+    g.set(2)
+    assert g.get() == 2
+    h = m.histogram("sort_serve_request_latency_seconds")
+    for v in (0.0004, 0.004, 0.04, 0.4, 400.0):
+        h.observe(v)
+    assert h.sample_count() == 5
+    assert h.get() == pytest.approx(400.4444)
+
+
+def test_unregistered_or_miskinded_metric_raises():
+    m = LiveMetrics()
+    with pytest.raises(KeyError):
+        m.counter("sort_made_up_total")
+    with pytest.raises(KeyError):
+        m.gauge("sort_serve_requests_total")  # registered as a counter
+    # the kind check holds on a WARM registry too: an existing counter
+    # family must not be handed out as a gauge (set() would overwrite
+    # the accumulated count)
+    m.counter("sort_serve_requests_total").inc(1, status="ok")
+    with pytest.raises(KeyError):
+        m.gauge("sort_serve_requests_total")
+    assert m.counter("sort_serve_requests_total").total() == 1
+
+
+def test_exposition_roundtrip_and_escaping():
+    m = LiveMetrics()
+    m.counter("sort_faults_total").inc(1, site='we"ird\\site')
+    m.histogram("sort_serve_batch_segments").observe(3)
+    m.histogram("sort_serve_batch_segments").observe(100)  # > last bound
+    text = m.render_prom()
+    assert check_exposition(text) == []
+    fams = parse_prom_text(text)
+    assert fams["sort_faults_total"]["type"] == "counter"
+    (_n, labels, v), = fams["sort_faults_total"]["samples"]
+    assert labels == {"site": 'we"ird\\site'} and v == 1
+    seg = {n: v for n, lbl, v in
+           fams["sort_serve_batch_segments"]["samples"]
+           if lbl.get("le") in ("4", "+Inf")}
+    assert seg["sort_serve_batch_segments_bucket"] in (1, 2)
+    # +Inf bucket == count == 2 (the 100 lands only there)
+    cnt = [v for n, _l, v in fams["sort_serve_batch_segments"]["samples"]
+           if n == "sort_serve_batch_segments_count"]
+    assert cnt == [2]
+
+
+def test_check_exposition_flags_unregistered_and_bad_grammar():
+    bad = "# TYPE nope_total counter\nnope_total 3\n"
+    assert any("not registered" in e for e in check_exposition(bad))
+    assert check_exposition("sort_serve_inflight notanumber\n")
+    with pytest.raises(ValueError):
+        parse_prom_text("sort_serve_inflight oops\n")
+
+
+def test_registry_vocabulary_is_well_formed():
+    for name, (kind, help_text) in metrics_live.METRICS.items():
+        assert kind in ("counter", "gauge", "histogram"), name
+        assert help_text, name
+    for name, buckets in metrics_live._HISTOGRAM_BUCKETS.items():
+        assert metrics_live.METRICS[name][0] == "histogram"
+        assert list(buckets) == sorted(buckets)
+
+
+# ------------------------------------------------------------ the bridge
+
+def test_span_bridge_maps_the_vocabulary():
+    m = LiveMetrics()
+    log = SpanLog()
+    log.observers.append(SpanMetricsBridge(m))
+    log.record("serve.request", 0.0, 0.02, status="ok", batched=True,
+               n=100, queue_s=0.003)
+    log.record("serve.request", 0.0, 0.5, status="integrity")
+    log.record("serve.request", 0.0, 0.0, status="backpressure",
+               reject="inflight")
+    log.record("serve.batch", 0.0, 0.01, segments=4, keys=1200)
+    log.record("serve.compile_cache", 0.0, 0.0, hit=False, compile_s=0.7)
+    log.record("serve.compile_cache", 0.0, 0.0, hit=True)
+    log.record("verify", 0.0, 0.0, ok=False)
+    log.record("phase:verify", 0.0, 0.25)
+    log.record("supervisor_retry", 0.0, 0.0, attempt=1)
+    log.record("fault", 0.0, 0.0, site="exchange_drop")
+    log.record("exchange_balance", 0.0, 0.0, recv_ratio=1.5,
+               peer_ratio=2.0, negotiated_cap=256, worst_cap=2048,
+               recv_bytes=[10, 20], send_bytes=[15, 15])
+    assert m.counter("sort_serve_requests_total").get(status="ok") == 1
+    assert m.counter("sort_serve_requests_total").total() == 3
+    # only the ok request is a latency sample
+    assert m.histogram(
+        "sort_serve_request_latency_seconds").sample_count() == 1
+    assert m.histogram("sort_serve_queue_wait_seconds").sample_count() == 1
+    assert m.counter("sort_serve_rejected_total").get(reason="inflight") == 1
+    assert m.counter("sort_serve_batch_keys_total").total() == 1200
+    assert m.counter("sort_serve_cache_misses_total").total() == 1
+    assert m.counter("sort_serve_cache_hits_total").total() == 1
+    assert m.counter("sort_serve_compile_seconds_total").total() == 0.7
+    assert m.counter("sort_verify_failures_total").total() == 1
+    assert m.counter("sort_verify_seconds_total").total() == 0.25
+    assert m.counter("sort_retries_total").total() == 1
+    assert m.counter("sort_faults_total").get(site="exchange_drop") == 1
+    assert m.gauge("sort_exchange_peer_ratio").get() == 2.0
+    assert m.gauge("sort_exchange_rank_recv_bytes").get(rank="1") == 20
+
+
+def test_bridge_errors_never_escape_the_span_path():
+    log = SpanLog()
+
+    def bomb(_s):
+        raise RuntimeError("observer bug")
+
+    log.observers.append(bomb)
+    with log.span("sort"):
+        pass
+    assert log.spans[0].name == "sort"  # the path survived
+
+
+# --------------------------------------------------------------- sampling
+
+def test_trace_sample_drops_whole_subtrees_keeps_schema(tmp_path):
+    stream = tmp_path / "trace.jsonl"
+    with knobs.scoped_env(SORT_TRACE_SAMPLE="0.5"):
+        log = SpanLog(stream_path=str(stream))
+        for _ in range(6):
+            with log.span("sort"):
+                with log.span("phase:encode"):
+                    log.event("verify", ok=True)
+    rows = report.load_rows(str(stream))
+    # every 2nd root kept -> exactly half the 18 spans streamed
+    assert len(rows) == 9
+    assert report.check_rows(rows) == []   # parent links all resolve
+    # retention and export are unaffected by stream sampling
+    assert len(log.spans) == 18
+
+
+def test_trace_sample_holds_for_any_rate(tmp_path):
+    """Error-diffusion keeps EXACTLY floor-accurate fractions at any
+    rate — a keep-every-Nth quantization would silently keep 100% for
+    every rate above 2/3."""
+    for rate, total, kept in (("0.75", 8, 6), ("0.9", 10, 9),
+                              ("0.25", 8, 2)):
+        stream = tmp_path / f"t{rate}.jsonl"
+        with knobs.scoped_env(SORT_TRACE_SAMPLE=rate):
+            log = SpanLog(stream_path=str(stream))
+            for _ in range(total):
+                with log.span("sort"):
+                    pass
+        assert len(report.load_rows(str(stream))) == kept, rate
+
+
+def test_trace_sample_one_keeps_everything(tmp_path):
+    stream = tmp_path / "trace.jsonl"
+    log = SpanLog(stream_path=str(stream))
+    with log.span("sort"):
+        log.event("verify", ok=True)
+    assert len(report.load_rows(str(stream))) == 2
+
+
+# ---------------------------------------------------------- trace context
+
+def test_trace_context_nesting_and_precedence():
+    log = SpanLog()
+    with trace_context(batch_id="b1"):
+        with trace_context(trace_id="t1"):
+            log.record("serve.request", 0.0, 0.1, n=1)
+            # explicit attrs beat context attrs
+            log.record("serve.request", 0.0, 0.1, trace_id="override")
+        log.record("serve.batch", 0.0, 0.1)
+    log.record("verify", 0.0, 0.0)
+    a = [s.attrs for s in log.spans]
+    assert a[0]["trace_id"] == "t1" and a[0]["batch_id"] == "b1"
+    assert a[1]["trace_id"] == "override"
+    assert a[2] == {"batch_id": "b1"}
+    assert "batch_id" not in a[3]
+
+
+def test_trace_context_is_thread_local():
+    log = SpanLog()
+    seen = {}
+
+    def other():
+        log.record("verify", 0.0, 0.0)
+        seen["attrs"] = log.spans[-1].attrs
+
+    with trace_context(trace_id="main-only"):
+        t = threading.Thread(target=other)
+        t.start()
+        t.join()
+    assert "trace_id" not in seen["attrs"]
+
+
+def test_worker_records_inherit_the_open_spans_context():
+    """Pipeline worker threads (ingest/egress stages) report via
+    SpanLog.record under the driver's innermost open span — they must
+    inherit THAT span's trace context, or large streamed-ingest
+    requests would lose their ingest stages from the --trace-id view."""
+    log = SpanLog()
+    done = threading.Event()
+    go = threading.Event()
+
+    def worker():
+        go.wait(5)
+        log.record("ingest.parse", 0.0, 0.01, bytes=4)
+        done.set()
+
+    t = threading.Thread(target=worker)
+    t.start()
+    with trace_context(trace_id="big-req"):
+        with log.span("sort"):
+            go.set()
+            assert done.wait(5)
+    t.join()
+    parse = [s for s in log.spans if s.name == "ingest.parse"]
+    assert parse[0].attrs["trace_id"] == "big-req"
+    # ...and outside any open span, no inheritance happens
+    log.record("ingest.parse", 0.0, 0.01)
+    assert "trace_id" not in log.spans[-1].attrs
+
+
+# -------------------------------------------------------- flight recorder
+
+def test_flight_ring_bound_and_dump_sanitizes_parents(tmp_path):
+    with knobs.scoped_env(SORT_FLIGHT_RECORDER_SIZE="8",
+                          SORT_FLIGHT_RECORDER_DIR=str(tmp_path)):
+        fr.reset()
+        try:
+            log = SpanLog()
+            with log.span("sort"):              # root: evicted later
+                for _ in range(12):             # children flood the ring
+                    log.event("verify", ok=True)
+            rec = fr.get()
+            assert rec.capacity == 8 and len(rec.ring) == 8
+            # the ring holds late children + the root (flushed LAST);
+            # early children's parent links must sanitize away
+            path = rec.dump("unit_test")
+            assert path is not None
+            rows = report.load_rows(path)
+            assert report.check_rows(rows) == []
+            assert sum(1 for r in rows if r.get("kind") == "span") == 8
+            # rate limit: same reason immediately again -> no dump
+            assert rec.dump("unit_test", rate_limit=True) is None
+            # a DIFFERENT reason dumps fine
+            assert rec.dump("other_reason", rate_limit=True) is not None
+        finally:
+            fr.reset()
+
+
+def test_flight_recorder_disabled_at_size_zero(tmp_path):
+    with knobs.scoped_env(SORT_FLIGHT_RECORDER_SIZE="0",
+                          SORT_FLIGHT_RECORDER_DIR=str(tmp_path)):
+        fr.reset()
+        try:
+            log = SpanLog()
+            with log.span("sort"):
+                pass
+            rec = fr.get()
+            assert not rec.enabled
+            assert rec.dump("nope") is None
+        finally:
+            fr.reset()
+
+
+def test_typed_error_dumps_flight_artifact(tmp_path, rng, mesh8):
+    """The acceptance path: a fault-injected typed error leaves an
+    artifact report.py --check accepts (ISSUE 10)."""
+    from mpitest_tpu.models import api
+    from mpitest_tpu.models.supervisor import SortIntegrityError
+
+    with knobs.scoped_env(SORT_FLIGHT_RECORDER_DIR=str(tmp_path),
+                          SORT_FAULTS="result_swap:inf",
+                          SORT_FALLBACK="0", SORT_MAX_RETRIES="0"):
+        fr.reset()
+        try:
+            x = rng.integers(-2**31, 2**31 - 1, size=4096, dtype=np.int32)
+            with pytest.raises(SortIntegrityError):
+                api.sort(x, algorithm="radix", mesh=mesh8)
+            dumps = sorted(tmp_path.glob("flight-*.jsonl"))
+            # two artifacts: the fault-site trigger, then the typed
+            # error itself (the later one carries the whole story)
+            assert len(dumps) == 2, dumps
+            for d in dumps:
+                assert report.main(["--check", str(d)]) == 0
+            names = {r.get("name")
+                     for r in report.load_rows(str(dumps[-1]))}
+            assert "fault" in names and "verify" in names
+        finally:
+            fr.reset()
+
+
+# ------------------------------------------- trace propagation (serving)
+
+def test_batched_requests_share_batch_id_keep_trace_ids(rng):
+    with serve_core(SORT_SERVE_BATCH_WINDOW_MS="60") as core:
+        arrs = [rng.integers(-2**31, 2**31 - 1, size=300, dtype=np.int32)
+                for _ in range(3)]
+        res: dict = {}
+
+        def send(i):
+            res[i] = core.execute(arrs[i], trace_id=f"tt{i}")
+
+        ts = [threading.Thread(target=send, args=(i,)) for i in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        for i in range(3):
+            st, _out, attrs = res[i]
+            assert st == "ok"
+            assert attrs["trace_id"] == f"tt{i}"
+            assert attrs["queue_s"] >= 0
+        bids = {res[i][2]["batch_id"] for i in range(3)}
+        assert len(bids) == 1
+        batch = [s for s in core.tracer.spans.spans
+                 if s.name == "serve.batch"]
+        assert sorted(batch[-1].attrs["trace_ids"]) == ["tt0", "tt1", "tt2"]
+        assert batch[-1].attrs["batch_id"] == bids.pop()
+
+
+def test_solo_request_stamps_every_sort_span(rng):
+    with serve_core(SORT_SERVE_BATCH_KEYS="128") as core:  # force solo
+        a = rng.integers(-2**31, 2**31 - 1, size=3000, dtype=np.int32)
+        st, out, attrs = core.execute(a, trace_id="solo-t")
+        assert st == "ok" and np.array_equal(out, np.sort(a))
+        assert attrs["batched"] is False
+        stamped = {s.name for s in core.tracer.spans.spans
+                   if s.attrs.get("trace_id") == "solo-t"}
+        # the umbrella, its phases, the verifier AND the reply span all
+        # carry the request's identity
+        assert {"serve.request", "sort", "verify"} <= stamped
+        assert any(n.startswith("phase:") for n in stamped)
+
+
+def test_retried_and_faulted_requests_keep_their_trace_id(rng, mesh8):
+    with serve_core(SORT_SERVE_ALLOW_FAULTS="1",
+                    SORT_MAX_RETRIES="2", SORT_FALLBACK="0") as core:
+        a = rng.integers(-2**31, 2**31 - 1, size=2048, dtype=np.int32)
+        st, out, _ = core.execute(a, faults_spec="dispatch_error:1",
+                                  trace_id="retry-t")
+        assert st == "ok" and np.array_equal(out, np.sort(a))
+        retries = [s for s in core.tracer.spans.spans
+                   if s.name == "supervisor_retry"]
+        assert retries and retries[-1].attrs["trace_id"] == "retry-t"
+
+    with serve_core(SORT_SERVE_ALLOW_FAULTS="1",
+                    SORT_MAX_RETRIES="0", SORT_FALLBACK="0") as core:
+        a = rng.integers(-2**31, 2**31 - 1, size=2048, dtype=np.int32)
+        st, _detail, attrs = core.execute(a, faults_spec="result_swap:inf",
+                                          trace_id="bad-t")
+        assert st == "integrity" and attrs["trace_id"] == "bad-t"
+        faulted = [s for s in core.tracer.spans.spans
+                   if s.name == "fault"
+                   and s.attrs.get("trace_id") == "bad-t"]
+        assert faulted, "fault events lost the request identity"
+
+
+# ------------------------------------------------------- report live mode
+
+_row_ids = iter(range(10_000))
+
+
+def _span_row(name, t0, dt, **attrs):
+    return {"kind": "span", "v": "span.v1", "name": name,
+            "id": next(_row_ids), "parent": None, "t0": t0, "dt": dt,
+            "pid": 1, "attrs": attrs}
+
+
+def test_trace_view_reconstructs_without_leaking_batchmates():
+    rows = [
+        _span_row("serve.request", 0.0, 0.1, trace_id="A", status="ok",
+                  n=10, dtype="int32", queue_s=0.01, batched=True,
+                  bucket=1024, batch_id="b1"),
+        _span_row("serve.request", 0.0, 0.2, trace_id="B", status="ok",
+                  n=20, batch_id="b1"),
+        _span_row("serve.batch", 0.05, 0.04, batch_id="b1",
+                  trace_ids=["A", "B"], segments=2, keys=30),
+        _span_row("serve.compile_cache", 0.06, 0.0, batch_id="b1",
+                  hit=True),
+        _span_row("sort", 0.0, 0.5, trace_id="Z"),   # unrelated request
+    ]
+    view = report.trace_view(rows, "A")
+    assert view is not None
+    assert "serve.batch" in view and "serve.compile_cache" in view
+    assert "+1 batchmate(s)" in view and "queue_wait=10.000ms" in view
+    assert "n=20" not in view            # batchmate B's request excluded
+    assert report.trace_view(rows, "nope") is None
+
+
+def test_serve_slo_error_budget_and_render():
+    serve = {"requests": [
+        {"dt": 0.01, "status": "ok", "batched": True, "n": 5},
+        {"dt": 0.01, "status": "ok", "batched": False, "n": 5},
+        {"dt": 0.5, "status": "integrity", "batched": False, "n": 5},
+    ], "batches": 1, "batch_segments": 2, "batch_keys": 10,
+        "cache_hits": 1, "cache_misses": 0, "compile_s": 0.0}
+    slo = report.serve_slo(serve, slo_target=99.0)
+    assert slo["error_rate_pct"] == pytest.approx(33.3333, abs=1e-3)
+    assert slo["budget_burn"] == pytest.approx(33.33, abs=0.01)
+    agg = {"phases": {}, "collectives": {}, "metrics": {}, "spans": {},
+           "ingest": {}, "robustness": {}, "scaleout": {},
+           "serve": serve, "tooling": None, "encode_engines": [],
+           "ingest_overlap": None, "egress_overlap": None}
+    text = report.render(agg, slo_target=99.0)
+    assert "error budget (99.0% target)" in text and "burn" in text
+
+
+def test_report_prom_snapshot_rendering(tmp_path):
+    m = LiveMetrics()
+    m.counter("sort_serve_requests_total").inc(99, status="ok")
+    m.counter("sort_serve_requests_total").inc(1, status="internal")
+    f = tmp_path / "scrape.prom"
+    f.write_text(m.render_prom())
+    out = report.render_prom_snapshot(str(f), f.read_text())
+    assert "requests internal=1, ok=99" in out
+    assert "error budget" in out and "burn 10.0x" in out
+    assert report.main(["--prom", str(f)]) == 0
+
+
+# ----------------------------------------------------- telemetry endpoints
+
+def test_telemetry_http_endpoints(rng):
+    from mpitest_tpu.serve.telemetry import TelemetryServer
+
+    with serve_core(SORT_SERVE_BATCH_WINDOW_MS="0") as core:
+        tel = TelemetryServer(core, "127.0.0.1", 0)
+        tel.start()
+        try:
+            a = rng.integers(-100, 100, size=256, dtype=np.int32)
+            assert core.execute(a, trace_id="ep-t")[0] == "ok"
+            base = f"http://127.0.0.1:{tel.bound_port}"
+
+            def get(path):
+                with urllib.request.urlopen(base + path, timeout=10) as r:
+                    return r.status, r.read()
+
+            st, body = get("/metrics")
+            assert st == 200
+            assert check_exposition(body.decode()) == []
+            fams = parse_prom_text(body.decode())
+            assert fams["sort_serve_requests_total"]["samples"]
+            st, body = get("/healthz")
+            assert st == 200 and json.loads(body)["ok"] is True
+            st, body = get("/varz")
+            vz = json.loads(body)
+            assert st == 200 and "admission" in vz and "mesh" in vz
+            st, body = get("/flightrecorder")
+            assert st == 200
+            rows = [json.loads(ln) for ln in body.decode().splitlines()
+                    if ln]
+            assert any(r.get("name") == "serve.request" for r in rows)
+            # draining flips healthz to 503
+            core.start_drain()
+            try:
+                urllib.request.urlopen(base + "/healthz", timeout=10)
+                raise AssertionError("expected 503 while draining")
+            except urllib.error.HTTPError as e:
+                assert e.code == 503
+        finally:
+            tel.shutdown()
+            tel.server_close()
+
+
+# ---------------------------------------------------------- bench history
+
+def _bench_envelope(tail_lines):
+    return json.dumps({"n": 1, "cmd": "bench", "rc": 0,
+                       "tail": "\n".join(tail_lines), "parsed": {}})
+
+
+def test_bench_history_table_and_regression_flags(tmp_path):
+    from tools import bench_history as bh
+
+    side1 = json.dumps({"ts": 1, "config": {}, "metrics": {
+        "sort_mkeys_per_s": {"value": 100.0},
+        "sort_incl_ingest_mkeys_per_s": {"value": 50.0}}})
+    row1 = json.dumps({"metric": "radix_sort_mkeys_per_s_2e20_int32",
+                       "value": 100.0})
+    (tmp_path / "BENCH_r01.json").write_text(
+        _bench_envelope(["noise", side1, row1]))
+    side2 = json.dumps({"ts": 2, "config": {}, "metrics": {
+        "sort_mkeys_per_s": {"value": 60.0},     # regressed
+        "sort_incl_ingest_mkeys_per_s": {"value": 55.0}}})
+    serve_row = json.dumps({"metric": "serve_small_mix_mkeys_per_s",
+                            "value": 0.5, "p99_ms": 20.0})
+    (tmp_path / "BENCH_r02.json").write_text(
+        _bench_envelope([side2, serve_row]))
+    runs = bh.find_runs(tmp_path)
+    assert [r[0] for r in runs] == [1, 2]
+    table, flags = bh.build_table(runs)
+    assert "| r01 | 100 |" in table
+    assert "⚠" in table and flags and "sort" in flags[0]
+    # derived ingest ratio appears for both rounds
+    assert "0.5" in table
+    assert bh.main(["--dir", str(tmp_path)]) == 0
+    assert bh.main(["--dir", str(tmp_path), "--strict"]) == 2

@@ -313,13 +313,21 @@ def test_server_driver_wire_and_sigterm_drain(tmp_path):
         rng = np.random.default_rng(3)
         x = rng.integers(-2**31, 2**31 - 1, size=700, dtype=np.int32)
         with ServeClient("127.0.0.1", port) as c:
-            r = c.sort(x)
+            r = c.sort(x, trace_id="wire-drill-1")
             assert r.ok and np.array_equal(r.arr, np.sort(x))
+            # the wire layer echoes the client-minted trace id (ISSUE 10)
+            assert r.trace_id == "wire-drill-1"
             # typed error, connection survives, next request works
             bad = c.sort(np.arange(8, dtype=np.int32), algo="bogus")
             assert not bad.ok and bad.error == "bad_request"
             r2 = c.sort(x)
             assert r2.ok
+            # a trace id is minted when the client supplies... the
+            # client always supplies one; the echo must be non-empty
+            assert r2.trace_id
+            # garbage trace ids are a typed wire error
+            bad_tid = c.sort(x, trace_id="spaces are not ok")
+            assert not bad_tid.ok and bad_tid.error == "bad_request"
         proc.send_signal(signal.SIGTERM)
         rc = proc.wait(timeout=60)
         assert rc == 0, proc.stderr.read()[-1000:]

@@ -81,6 +81,36 @@ def test_sl000_suppression_needs_reason():
     assert rules_of(lint_source(sup_bad, "x.py")) == ["SL000", "SL003"]
 
 
+def test_sl004_metric_names_come_from_registry():
+    bad = "metrics.counter('made_up_total').inc(1)\n"
+    assert rules_of(lint_source(bad, "x.py")) == ["SL004"]
+    # wrong kind for a registered name
+    kind = "metrics.gauge('sort_serve_requests_total').set(1)\n"
+    assert rules_of(lint_source(kind, "x.py")) == ["SL004"]
+    nonlit = "metrics.counter(name).inc(1)\n"
+    assert rules_of(lint_source(nonlit, "x.py")) == ["SL004"]
+    good = ("self.metrics.counter('sort_serve_requests_total')"
+            ".inc(1, status='ok')\n"
+            "metrics.histogram('sort_serve_queue_wait_seconds')"
+            ".observe(0.1)\n")
+    assert lint_source(good, "x.py") == []
+    # unrelated receivers never match (kernels.histogram is a jnp op)
+    unrelated = "h = kernels.histogram(dest, n_ranks)\n"
+    assert lint_source(unrelated, "x.py") == []
+    # the registry module itself is exempt
+    assert lint_source(bad, "mpitest_tpu/utils/metrics_live.py") == []
+
+
+def test_metrics_registry_vocabulary():
+    from mpitest_tpu.utils import metrics_live
+
+    assert all(kind in ("counter", "gauge", "histogram") and doc
+               for kind, doc in metrics_live.METRICS.values())
+    # every histogram bucket set belongs to a registered histogram
+    for name in metrics_live._HISTOGRAM_BUCKETS:
+        assert metrics_live.METRICS[name][0] == "histogram"
+
+
 def test_sl010_lax_reduce_banned():
     bad = "import jax\nout = jax.lax.reduce(x, 0, op, (0,))\n"
     assert rules_of(lint_source(bad, "x.py")) == ["SL010"]

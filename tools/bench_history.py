@@ -1,0 +1,183 @@
+#!/usr/bin/env python3
+"""Bench-trajectory report: the BENCH_r01→rNN history as one table.
+
+The repo pins one ``BENCH_rNN.json`` snapshot per bench round (the
+driver envelope: ``{"n", "cmd", "rc", "tail", "parsed"}`` where
+``tail`` carries the run's stdout — metrics-sidecar and bench-row JSON
+lines included), but nothing read them ACROSS rounds: the performance
+story lived in prose.  This tool parses every snapshot, extracts the
+trajectory columns — raw sort throughput, end-to-end (ingest-included)
+throughput and its ratio, the scale-out row's cap saving, the serve
+row's SLO numbers — and renders a markdown table with one row per
+round plus per-metric regression flags: a value below ``threshold``
+(default 0.9) of the best earlier round is marked ``⚠ (0.83x)``.
+
+Usage::
+
+    python tools/bench_history.py [--dir .] [--threshold 0.9]
+    make bench-history
+
+Exit code 0 always — the trajectory is a report, not a gate (the
+per-PR gates live in report.py ``--baseline`` and the selftests);
+``--strict`` exits 2 when any flag fires, for CI jobs that want one.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from pathlib import Path
+
+#: (column key, pretty header, unit, higher-is-better) — the trajectory
+#: columns.  ``None`` cells render as ``-`` (a round predating the
+#: metric is not a regression).
+COLUMNS: tuple[tuple[str, str, str, bool], ...] = (
+    ("sort_mkeys_per_s", "sort", "Mkeys/s", True),
+    ("sort_incl_ingest_mkeys_per_s", "incl-ingest", "Mkeys/s", True),
+    ("ingest_ratio", "ingest ratio", "x", True),
+    ("encode_gb_per_s", "encode", "GB/s", True),
+    ("cap_saving_pct", "cap saving", "%", True),
+    ("serve_mkeys_per_s", "serve", "Mkeys/s", True),
+    ("serve_p99_ms", "serve p99", "ms", False),
+)
+
+_RUN_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def _json_lines(text: str) -> list[dict]:
+    out = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(obj, dict):
+            out.append(obj)
+    return out
+
+
+def load_run(path: Path) -> dict[str, float]:
+    """Extract the trajectory metrics from one BENCH_rNN.json envelope.
+    Both record shapes in the tail are folded: metrics sidecars
+    (``{"config", "metrics": {name: {"value": ...}}}``) and bench rows
+    (``{"metric", "value", ...}`` — including the ``_8dev`` scale-out
+    and serve rows with their extra fields)."""
+    env = json.loads(path.read_text())
+    vals: dict[str, float] = {}
+
+    def put(name: str, v: object) -> None:
+        try:
+            vals[name] = float(v)  # type: ignore[arg-type]
+        except (TypeError, ValueError):
+            pass
+
+    for obj in _json_lines(str(env.get("tail", ""))):
+        if "metrics" in obj and "config" in obj:
+            for mname, m in obj["metrics"].items():
+                if isinstance(m, dict) and "value" in m:
+                    put(mname, m["value"])
+        elif "metric" in obj and "value" in obj:
+            name = str(obj["metric"])
+            if name.startswith("serve_"):
+                put("serve_mkeys_per_s", obj["value"])
+                put("serve_p99_ms", obj.get("p99_ms"))
+            elif name.endswith("_8dev"):
+                put("cap_saving_pct", obj.get("cap_saving_pct"))
+            else:
+                put("sort_row_mkeys_per_s", obj["value"])
+    # derived: end-to-end ratio when a round recorded both throughputs
+    # but not the ratio itself (pre-ISSUE-6 rounds)
+    if "ingest_ratio" not in vals and \
+            vals.get("sort_mkeys_per_s") and \
+            vals.get("sort_incl_ingest_mkeys_per_s"):
+        vals["ingest_ratio"] = round(
+            vals["sort_incl_ingest_mkeys_per_s"] / vals["sort_mkeys_per_s"],
+            3)
+    # the sidecar's sort_mkeys_per_s and the bench row agree by
+    # construction; fall back to the row when only it parsed
+    if "sort_mkeys_per_s" not in vals and "sort_row_mkeys_per_s" in vals:
+        vals["sort_mkeys_per_s"] = vals["sort_row_mkeys_per_s"]
+    return vals
+
+
+def find_runs(directory: Path) -> list[tuple[int, Path]]:
+    runs = []
+    for p in sorted(directory.glob("BENCH_r*.json")):
+        m = _RUN_RE.search(p.name)
+        if m:
+            runs.append((int(m.group(1)), p))
+    return sorted(runs)
+
+
+def build_table(runs: list[tuple[int, Path]],
+                threshold: float = 0.9) -> tuple[str, list[str]]:
+    """(markdown table, regression flag descriptions).  A cell is
+    flagged when it is worse than ``threshold`` x the best earlier
+    round (direction per column); earlier-missing metrics never flag."""
+    rows = [(rid, load_run(p)) for rid, p in runs]
+    flags: list[str] = []
+    header = "| run | " + " | ".join(
+        f"{title} ({unit})" for _k, title, unit, _hib in COLUMNS) + " |"
+    sep = "|---" * (len(COLUMNS) + 1) + "|"
+    lines = [header, sep]
+    best: dict[str, float] = {}
+    for rid, vals in rows:
+        cells = [f"r{rid:02d}"]
+        for key, title, _unit, hib in COLUMNS:
+            v = vals.get(key)
+            if v is None:
+                cells.append("-")
+                continue
+            cell = f"{v:g}"
+            prev = best.get(key)
+            if prev is not None:
+                regressed = (v < threshold * prev) if hib else \
+                    (v > prev / threshold)
+                if regressed:
+                    ratio = (v / prev) if hib else (prev / v)
+                    cell += f" ⚠ ({ratio:.2f}x)"
+                    flags.append(
+                        f"r{rid:02d} {title}: {v:g} vs best {prev:g} "
+                        f"({ratio:.2f}x, threshold {threshold:g})")
+            best[key] = max(prev, v) if (prev is not None and hib) else \
+                min(prev, v) if prev is not None else v
+            cells.append(cell)
+        lines.append("| " + " | ".join(cells) + " |")
+    return "\n".join(lines), flags
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default=".",
+                    help="directory holding BENCH_rNN.json (default .)")
+    ap.add_argument("--threshold", type=float, default=0.9,
+                    help="flag when worse than THRESHOLD x the best "
+                         "earlier round (default 0.9)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 2 when any regression flag fires")
+    args = ap.parse_args(argv)
+    runs = find_runs(Path(args.dir))
+    if not runs:
+        print(f"[ERROR] no BENCH_rNN.json under {args.dir!r}",
+              file=sys.stderr)
+        return 1
+    table, flags = build_table(runs, args.threshold)
+    print(f"bench trajectory ({len(runs)} round(s), regression "
+          f"threshold {args.threshold:g}):\n")
+    print(table)
+    if flags:
+        print("\nregression flags:")
+        for f in flags:
+            print(f"  ⚠ {f}")
+    else:
+        print("\nno regression flags")
+    return 2 if (flags and args.strict) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,6 +14,10 @@ SL003     literal span/phase names must come from the registered schema
           (``utils/span_schema.py``) that report.py aggregates by — a
           renamed span must fail the lint, not silently vanish from the
           telemetry tables.
+SL004     literal live-metric names (``<metrics>.counter/gauge/
+          histogram("...")``) must come from the registered vocabulary
+          in ``utils/metrics_live.py`` — same contract as SL003 for the
+          /metrics exposition surface (ISSUE 10).
 SL010     no ``lax.reduce`` — custom reduction computations are
           UNIMPLEMENTED under the SPMD partitioner (CHANGES.md, PR 3);
           use halving folds / jnp reductions.

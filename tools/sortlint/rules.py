@@ -205,6 +205,80 @@ register(Rule(
     _check_span_name))
 
 
+# ---------------------------------------------------------------- SL004
+
+def _load_metrics_registry() -> Any:
+    """utils/metrics_live.py by file path (stdlib-only by design, like
+    span_schema) — SL004 checks against the real METRICS dict."""
+    path = REPO_ROOT / "mpitest_tpu" / "utils" / "metrics_live.py"
+    spec = importlib.util.spec_from_file_location(
+        "_sortlint_metrics_live", path)
+    assert spec is not None and spec.loader is not None
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_METRICS_MOD = _load_metrics_registry()
+
+#: The module that IS the metric registry — the rule polices its users.
+_METRICS_EXEMPT = ("mpitest_tpu/utils/metrics_live.py",)
+
+#: Receiver names that denote a live-metrics registry.  Attribute-shaped
+#: matching like SL003: `<metrics-ish>.counter/gauge/histogram("name")`
+#: — unrelated bases (e.g. ``kernels.histogram``) never match.
+_METRIC_BASES = ("metrics", "live_metrics", "mlive", "registry")
+
+
+def _check_metric_name(path: str, src: str, tree: ast.AST) -> list[Finding]:
+    if _ends(path, *_METRICS_EXEMPT):
+        return []
+    out = []
+    for node, _ in _walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not isinstance(f, ast.Attribute) or \
+                f.attr not in ("counter", "gauge", "histogram"):
+            continue
+        base = f.value
+        base_name = base.id if isinstance(base, ast.Name) else \
+            base.attr if isinstance(base, ast.Attribute) else ""
+        if base_name not in _METRIC_BASES or not node.args:
+            continue
+        first = node.args[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            name = first.value
+            if name not in _METRICS_MOD.METRICS:
+                out.append(Finding(
+                    "SL004", path, node.lineno,
+                    f"metric name {name!r} is not registered in "
+                    "utils/metrics_live.py METRICS; register it there "
+                    "(the /metrics exposition check and report.py key "
+                    "on these names — unregistered metrics fail the "
+                    "telemetry selftest)"))
+            else:
+                kind = _METRICS_MOD.METRICS[name][0]
+                if kind != f.attr:
+                    out.append(Finding(
+                        "SL004", path, node.lineno,
+                        f"metric {name!r} is registered as a {kind} but "
+                        f"used via .{f.attr}()"))
+        else:
+            out.append(Finding(
+                "SL004", path, node.lineno,
+                "non-literal metric name — the registered-name check "
+                "cannot see it; use a literal, or suppress with a "
+                "reason"))
+    return out
+
+
+register(Rule(
+    "SL004", "metric-name-registry",
+    "literal metric names must come from utils/metrics_live.py METRICS",
+    _check_metric_name))
+
+
 # ------------------------------------------------------- SL010 / SL011 / SL012
 
 def _check_lax_reduce(path: str, src: str, tree: ast.AST) -> list[Finding]:

@@ -14,7 +14,7 @@
 #                  JSONL) + a native run with COMM_STATS, both validated
 #                  by `python -m mpitest_tpu.report --check`
 #   make fault-selftest — chaos-test matrix (ISSUE 3): the full
-#                  SORT_FAULTS grid (8 fault sites x {sample, radix}),
+#                  SORT_FAULTS grid (9 fault sites x {sample, radix}),
 #                  persistent-fault ladder cells, the CLI's typed exit
 #                  codes, and the native COMM_FAULTS kill/stall drills.
 #                  Every cell must recover with a fingerprint-verified
@@ -53,6 +53,20 @@
 #                  gracefully.  The server span stream then passes
 #                  `report.py --check --require-registered-spans` and
 #                  renders the p50/p99 SLO table.
+#   make chaos-serve-selftest — the wire-chaos gate (ISSUE 11): a real
+#                  sort_server behind the chaos TCP proxy
+#                  (bench/wire_chaos.py).  Every wire-fault cell (torn
+#                  header, stalled/slow-dripped payload, raw-RST kill
+#                  mid-payload, mid-response disconnect, connect-then-
+#                  silence) must end with the server alive, in-flight
+#                  admission bytes back to 0 (scraped from /metrics),
+#                  zero leaked handler threads, and a clean follow-up
+#                  request served bit-exact; a wedged dispatch must
+#                  trip the watchdog (healthz 503, typed fast
+#                  rejections, flight-recorder artifact that passes
+#                  report.py --check) and recover via the breaker's
+#                  half-open probe; and hedging must cut the
+#                  injected-tail p99 strictly below the unhedged run.
 #   make lint    — static analysis (ISSUE 4): sortlint (the project's
 #                  custom AST rules — env-knob registry, span schema,
 #                  SPMD safety, fault coverage, typed core), the
@@ -78,8 +92,8 @@ PYTHON ?= python3
 
 .PHONY: test native native-encode chip-test telemetry-selftest \
     ingest-selftest fault-selftest multichip-selftest serve-selftest \
-    lint cwarn-check typecheck tidy-check knob-docs sanitize-selftest \
-    bench-history clean
+    chaos-serve-selftest lint cwarn-check typecheck tidy-check \
+    knob-docs sanitize-selftest bench-history clean
 
 chip-test:
 	$(PYTHON) -u bench/chip_regression.py
@@ -170,6 +184,21 @@ serve-selftest:
 	    $(SERVE_TMP)/server_trace_batched.jsonl
 	$(PYTHON) -m mpitest_tpu.report \
 	    $(SERVE_TMP)/server_trace_batched.jsonl $(SERVE_TMP)/metrics.jsonl
+
+# The wire-chaos gate (ISSUE 11) — see bench/chaos_serve_selftest.py.
+# Real servers behind the chaos TCP proxy on a plain 1-device CPU
+# backend: the faults live on the wire and in the dispatch thread, not
+# in the device math.
+CHAOS_TMP := /tmp/mpitest_chaos_selftest
+chaos-serve-selftest:
+	rm -rf $(CHAOS_TMP) && mkdir -p $(CHAOS_TMP)
+	JAX_PLATFORMS=cpu \
+	    $(PYTHON) -u bench/chaos_serve_selftest.py --out $(CHAOS_TMP)
+	$(PYTHON) -m mpitest_tpu.report --check --require-registered-spans \
+	    $(CHAOS_TMP)/server_trace_chaos.jsonl \
+	    $(CHAOS_TMP)/server_trace_watchdog.jsonl
+	$(PYTHON) -m mpitest_tpu.report \
+	    $(CHAOS_TMP)/server_trace_watchdog.jsonl
 
 # Proof the streamed ingest pipeline is live, overlapping, and fast
 # (ISSUE 6): the NATIVE encode engine is built and FORCED ON for every

@@ -1,0 +1,423 @@
+#!/usr/bin/env python3
+"""Wire-chaos matrix: the `make chaos-serve-selftest` gate (ISSUE 11).
+
+Drives a REAL spawned ``sort_server`` through the chaos TCP proxy
+(``bench/wire_chaos.py``) and a hostile raw socket, one wire-fault
+cell at a time, and asserts the request-lifecycle robustness contract
+after EVERY cell:
+
+    the server is alive (``/healthz`` reachable), its in-flight
+    admission bytes are back to 0 (scraped from ``/metrics`` within
+    the read timeout), no handler threads leaked (the ``/healthz``
+    thread census returns to its baseline), and a clean follow-up
+    request is served bit-exact.
+
+Cells:
+
+* ``wire_torn_header``         — client dies mid-header.
+* ``wire_stall_payload``       — slow-loris: payload stalls at byte k;
+  the server must disconnect it within ``SORT_SERVE_READ_TIMEOUT_S``
+  and reclaim the admitted bytes (the PR 7 leak this PR fixes).
+* killed mid-payload           — raw socket RST halfway through the
+  payload (the satellite regression: admission bytes to 0).
+* ``wire_slow_drip``           — one byte trickle: per-chunk progress,
+  so only the TOTAL read budget bounds it.
+* ``wire_disconnect_response`` — network dies mid-download: the
+  client's problem, never the server's.
+* ``wire_connect_silence``     — the resilient client gives up within
+  its bounded retry budget instead of hanging.
+* watchdog drill               — a per-request ``dispatch_stall``
+  fault wedges the REAL dispatch thread past
+  ``SORT_SERVE_DISPATCH_TIMEOUT_S``: the watchdog must trip
+  (``/healthz`` 503, fast typed rejections, a flight-recorder
+  artifact that passes ``report.py --check``), then the breaker must
+  half-open and recover WITHOUT a restart once the dispatch returns.
+* hedging cell                 — deterministic injected tail (every
+  4th connection's response held 700 ms): hedged p99 must be
+  STRICTLY below the unhedged p99 on the same fault schedule.
+
+Runs TPU-free (plain 1-device CPU backend; the faults live on the
+wire and in the dispatch thread, not in the device math).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "bench"))
+
+from serve_load import HOST, Server, log                     # noqa: E402
+from wire_chaos import ChaosProxy                            # noqa: E402
+
+from mpitest_tpu.report import percentile                    # noqa: E402
+from mpitest_tpu.serve.client import (                       # noqa: E402
+    ResilientClient, ServeClient)
+from mpitest_tpu.utils import metrics_live                   # noqa: E402
+
+#: Server-side wire budget for the stall cells — every stalled
+#: connection must be shed (and its bytes reclaimed) within this.
+READ_TIMEOUT_S = 2.0
+
+#: Injected response delay of the hedging cell (ms) and its cadence.
+TAIL_DELAY_MS = 700
+TAIL_EVERY = 4
+
+results: list[tuple[str, bool, str]] = []
+
+
+def cell(name: str, ok: bool, detail: str) -> None:
+    results.append((name, ok, detail))
+    print(f"  {'ok ' if ok else 'BAD'} {name:<34} {detail}", flush=True)
+
+
+# ------------------------------------------------------------ scraping
+
+def scrape(port: int, route: str) -> tuple[int, str]:
+    req = urllib.request.Request(f"http://{HOST}:{port}{route}")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, r.read().decode("utf-8")
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode("utf-8")
+
+
+def inflight_bytes(metrics_port: int) -> float:
+    _code, text = scrape(metrics_port, "/metrics")
+    fams = metrics_live.parse_prom_text(text)
+    fam = fams.get("sort_serve_inflight_bytes")
+    if not fam or not fam["samples"]:
+        return 0.0
+    return sum(v for _n, _l, v in fam["samples"])
+
+
+def counter_total(metrics_port: int, name: str) -> float:
+    _code, text = scrape(metrics_port, "/metrics")
+    fams = metrics_live.parse_prom_text(text)
+    fam = fams.get(name)
+    if not fam:
+        return 0.0
+    return sum(v for n, _l, v in fam["samples"] if n == name)
+
+
+def healthz(metrics_port: int) -> tuple[int, dict]:
+    code, text = scrape(metrics_port, "/healthz")
+    return code, json.loads(text)
+
+
+def wait_until(pred, timeout_s: float, interval: float = 0.1) -> bool:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+# ------------------------------------------------------- the invariant
+
+def assert_recovered(name: str, srv: Server, baseline_threads: int,
+                     rng: np.random.Generator) -> None:
+    """The post-cell contract every chaos cell must satisfy."""
+    assert srv.metrics_port is not None
+    # 1. admission bytes provably reclaimed within the read budget
+    bytes_ok = wait_until(
+        lambda: inflight_bytes(srv.metrics_port) == 0,
+        READ_TIMEOUT_S + 3.0)
+    # 2. handler threads reclaimed (the stalled one exits at the
+    #    budget; +1 slack for a scrape handler mid-flight)
+    def threads_ok() -> bool:
+        code, h = healthz(srv.metrics_port)
+        return h["threads"] <= baseline_threads + 1
+    th_ok = wait_until(threads_ok, READ_TIMEOUT_S + 3.0)
+    # 3. server alive and serving: a clean follow-up request bit-exact
+    x = rng.integers(-2**31, 2**31 - 1, size=700, dtype=np.int32)
+    try:
+        with ServeClient(HOST, srv.port, timeout=30) as c:
+            r = c.sort(x)
+        clean_ok = bool(r.ok and np.array_equal(r.arr, np.sort(x)))
+    except (OSError, ConnectionError) as e:
+        clean_ok = False
+        r = None
+    detail = (f"inflight0={bytes_ok} threads={th_ok} "
+              f"follow-up={'ok' if clean_ok else 'FAILED'}")
+    cell(name, bytes_ok and th_ok and clean_ok, detail)
+
+
+# ----------------------------------------------------------- the cells
+
+def wire_cells(out: Path, rng: np.random.Generator) -> None:
+    srv = Server(out, "chaos", {
+        "SORT_SERVE_SHAPE_BUCKETS": "10",
+        "SORT_SERVE_READ_TIMEOUT_S": str(READ_TIMEOUT_S),
+        "SORT_SERVE_IDLE_TIMEOUT_S": "60",
+    })
+    try:
+        assert srv.metrics_port is not None
+        # warm once so compiles / lazy series are out of the way
+        x = rng.integers(-2**31, 2**31 - 1, size=700, dtype=np.int32)
+        with ServeClient(HOST, srv.port) as c:
+            assert c.sort(x).ok
+        _code, h = healthz(srv.metrics_port)
+        baseline = h["threads"]
+        log(f"chaos server up (baseline threads={baseline})")
+
+        # -- torn header ------------------------------------------
+        with ChaosProxy(HOST, srv.port, "wire_torn_header@5") as px:
+            try:
+                ServeClient(HOST, px.port, timeout=5).sort(x)
+                outcome = "reply?!"
+            except (OSError, ConnectionError):
+                outcome = "conn error (expected)"
+        log(f"torn header: client saw {outcome}")
+        assert_recovered("wire_torn_header", srv, baseline, rng)
+
+        # -- stalled payload at byte k (slow-loris) ----------------
+        t0 = time.monotonic()
+        with ChaosProxy(HOST, srv.port, "wire_stall_payload@64") as px:
+            try:
+                r = ServeClient(HOST, px.port,
+                                timeout=READ_TIMEOUT_S + 8).sort(x)
+                outcome = f"typed {r.error}"
+            except (OSError, ConnectionError):
+                outcome = "conn closed"
+            shed_s = time.monotonic() - t0
+        within = shed_s <= READ_TIMEOUT_S + 3.0
+        log(f"stalled payload: {outcome} after {shed_s:.2f}s "
+            f"(read timeout {READ_TIMEOUT_S:g}s)")
+        cell("stall shed within read timeout", within,
+             f"{shed_s:.2f}s <= {READ_TIMEOUT_S + 3.0:g}s")
+        assert_recovered("wire_stall_payload", srv, baseline, rng)
+
+        # -- killed mid-payload (raw RST; the satellite regression) -
+        big = rng.integers(-2**31, 2**31 - 1, size=1 << 16,
+                           dtype=np.int32)
+        hdr = json.dumps({"v": "sortserve.v1", "dtype": "int32",
+                          "n": int(big.size)}).encode() + b"\n"
+        s = socket.create_connection((HOST, srv.port), timeout=10)
+        s.sendall(hdr + big.tobytes()[: big.nbytes // 2])
+        time.sleep(0.2)      # let the server start (and block on) the read
+        # RST, not FIN: the kill -9 shape, no orderly shutdown
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                     struct.pack("ii", 1, 0))
+        s.close()
+        assert_recovered("killed mid-payload", srv, baseline, rng)
+
+        # -- slow-drip writes (progress per chunk, budget still binds)
+        with ChaosProxy(HOST, srv.port, "wire_slow_drip@300") as px:
+            try:
+                r = ServeClient(HOST, px.port,
+                                timeout=READ_TIMEOUT_S + 8).sort(big)
+                outcome = f"typed {r.error}"
+            except (OSError, ConnectionError):
+                outcome = "conn closed"
+        log(f"slow drip: {outcome}")
+        assert_recovered("wire_slow_drip", srv, baseline, rng)
+
+        # -- mid-response disconnect -------------------------------
+        with ChaosProxy(HOST, srv.port,
+                        "wire_disconnect_response@16") as px:
+            try:
+                ServeClient(HOST, px.port, timeout=10).sort(x)
+                outcome = "reply?!"
+            except (OSError, ConnectionError):
+                outcome = "short response (expected)"
+        log(f"mid-response disconnect: {outcome}")
+        assert_recovered("wire_disconnect_response", srv, baseline, rng)
+
+        # -- connect-then-silence: the client must give up, bounded -
+        with ChaosProxy(HOST, srv.port, "wire_connect_silence") as px:
+            rc = ResilientClient(HOST, px.port, connect_timeout=1.0,
+                                 read_timeout=1.0, max_attempts=2,
+                                 backoff_s=0.05)
+            t0 = time.monotonic()
+            try:
+                rc.sort(x)
+                bounded = False
+            except (OSError, ConnectionError):
+                bounded = (time.monotonic() - t0) < 10.0
+        cell("wire_connect_silence bounded", bounded,
+             f"gave up in {time.monotonic() - t0:.2f}s after "
+             f"{rc.stats['attempts']} attempt(s)")
+        assert_recovered("wire_connect_silence", srv, baseline, rng)
+
+        # enforced timeouts must be visible in /metrics
+        timeouts = counter_total(srv.metrics_port,
+                                 "sort_serve_timeouts_total")
+        cell("timeouts_total exported", timeouts >= 2.0,
+             f"sort_serve_timeouts_total={timeouts:g}")
+    finally:
+        rc_stop = srv.stop()
+        cell("chaos server SIGTERM drain", rc_stop == 0,
+             f"rc={rc_stop}")
+
+
+def watchdog_cell(out: Path, rng: np.random.Generator) -> None:
+    srv = Server(out, "watchdog", {
+        "SORT_SERVE_SHAPE_BUCKETS": "10",
+        "SORT_SERVE_ALLOW_FAULTS": "1",
+        "SORT_FAULT_STALL_MS": "4000",
+        "SORT_SERVE_DISPATCH_TIMEOUT_S": "1",
+        "SORT_SERVE_BREAKER_BACKOFF_S": "0.5",
+        "SORT_FLIGHT_RECORDER_DIR": str(out / "flightrec"),
+        # the dispatch fault sites live on the DISTRIBUTED sort path
+        # (supervisor.dispatch); a 1-device process takes the fused
+        # local path and would never stall — same 2-device virtual
+        # mesh the serve-selftest fault leg uses
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+    })
+    try:
+        assert srv.metrics_port is not None
+        x = rng.integers(-2**31, 2**31 - 1, size=700, dtype=np.int32)
+        with ServeClient(HOST, srv.port) as c:
+            assert c.sort(x).ok            # warm
+        stalled: dict = {}
+
+        def stalled_request() -> None:
+            try:
+                with ServeClient(HOST, srv.port, timeout=60) as c:
+                    stalled["reply"] = c.sort(x, faults="dispatch_stall")
+            except (OSError, ConnectionError) as e:
+                stalled["exc"] = e
+
+        t = threading.Thread(target=stalled_request, daemon=True)
+        t.start()
+        tripped = wait_until(
+            lambda: healthz(srv.metrics_port)[0] == 503, 3.5)
+        cell("watchdog trips -> healthz 503", tripped,
+             f"breaker={healthz(srv.metrics_port)[1].get('breaker')}")
+        # while open: admission is a FAST typed rejection
+        try:
+            with ServeClient(HOST, srv.port, timeout=10) as c:
+                r = c.sort(x)
+            fast_reject = (not r.ok) and r.error == "backpressure"
+            detail = f"error={r.error}"
+        except (OSError, ConnectionError) as e:
+            fast_reject, detail = False, f"transport: {e}"
+        cell("breaker fast-rejects typed", fast_reject, detail)
+        # the wedged dispatch returns at ~4s; the half-open probe
+        # must then close the breaker WITHOUT a restart
+        recovered = wait_until(
+            lambda: healthz(srv.metrics_port)[0] == 200, 20.0)
+        cell("breaker half-opens and recovers", recovered,
+             f"breaker={healthz(srv.metrics_port)[1].get('breaker')}")
+        t.join(timeout=30)
+        r = stalled.get("reply")
+        cell("stalled request still served", bool(r is not None and r.ok),
+             f"reply={'ok' if r is not None and r.ok else stalled}")
+        trips = counter_total(srv.metrics_port,
+                              "sort_serve_watchdog_trips_total")
+        cell("watchdog_trips_total exported", trips >= 1.0,
+             f"{trips:g} trip(s)")
+        # clean request after recovery
+        with ServeClient(HOST, srv.port, timeout=30) as c:
+            r2 = c.sort(x)
+        cell("post-recovery request ok",
+             bool(r2.ok and np.array_equal(r2.arr, np.sort(x))),
+             f"ok={r2.ok}")
+        # flight-recorder artifact: exists and passes report --check
+        artifacts = sorted((out / "flightrec").glob(
+            "flight-*-watchdog-*.jsonl"))
+        if not artifacts:
+            cell("watchdog flight artifact", False, "no artifact written")
+        else:
+            chk = subprocess.run(
+                [sys.executable, "-m", "mpitest_tpu.report", "--check",
+                 str(artifacts[-1])],
+                capture_output=True, text=True, cwd=str(REPO),
+                timeout=120)
+            cell("watchdog flight artifact", chk.returncode == 0,
+                 f"{artifacts[-1].name}: report --check rc="
+                 f"{chk.returncode}"
+                 + ("" if chk.returncode == 0
+                    else f" ({chk.stderr.strip()[:120]})"))
+    finally:
+        srv.stop()
+
+
+def hedging_cell(out: Path, rng: np.random.Generator) -> None:
+    """Injected-tail p99: hedged strictly below unhedged on the SAME
+    deterministic fault schedule (every 4th connection's response held
+    TAIL_DELAY_MS)."""
+    srv = Server(out, "hedge", {
+        "SORT_SERVE_SHAPE_BUCKETS": "10",
+        "SORT_SERVE_BATCH_WINDOW_MS": "0",
+    })
+    try:
+        x = rng.integers(-2**31, 2**31 - 1, size=700, dtype=np.int32)
+        with ServeClient(HOST, srv.port) as c:
+            assert c.sort(x).ok            # warm
+        spec = f"wire_delay_response@{TAIL_DELAY_MS}:{TAIL_EVERY}"
+        n_req = 24
+
+        def run(hedge: "float | None") -> list[float]:
+            lats = []
+            with ChaosProxy(HOST, srv.port, spec) as px:
+                client = ResilientClient(
+                    HOST, px.port, read_timeout=30.0, max_attempts=1,
+                    hedge_after_s=hedge)
+                for i in range(n_req):
+                    a = rng.integers(-2**31, 2**31 - 1, size=512,
+                                     dtype=np.int32)
+                    t0 = time.perf_counter()
+                    r = client.sort(a)
+                    lats.append(time.perf_counter() - t0)
+                    assert r.ok and np.array_equal(r.arr, np.sort(a)), \
+                        f"hedging cell reply {i} bad: {r.header}"
+            return sorted(lats)
+
+        unhedged = run(None)
+        hedged = run(0.1)
+        p99_u = percentile(unhedged, 99) * 1e3
+        p99_h = percentile(hedged, 99) * 1e3
+        log(f"hedging: unhedged p50 {percentile(unhedged, 50)*1e3:.1f} "
+            f"p99 {p99_u:.1f} ms; hedged p50 "
+            f"{percentile(hedged, 50)*1e3:.1f} p99 {p99_h:.1f} ms")
+        cell("hedged p99 < unhedged p99", p99_h < p99_u,
+             f"{p99_h:.1f} ms < {p99_u:.1f} ms "
+             f"(injected tail {TAIL_DELAY_MS} ms on every "
+             f"{TAIL_EVERY}th connection)")
+    finally:
+        srv.stop()
+
+
+def main() -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default="/tmp/mpitest_chaos_selftest")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    out = Path(args.out)
+    out.mkdir(parents=True, exist_ok=True)
+    rng = np.random.default_rng(args.seed)
+
+    print("wire-chaos cells: server survives, bytes reclaimed, "
+          "threads bounded, next request served")
+    wire_cells(out, rng)
+    print("watchdog drill: wedged dispatch -> trip -> half-open -> "
+          "recover")
+    watchdog_cell(out, rng)
+    print("hedging: injected-tail p99 strictly cut")
+    hedging_cell(out, rng)
+
+    n_bad = sum(1 for _n, ok, _d in results if not ok)
+    print(f"\nchaos-serve-selftest: {len(results) - n_bad}/"
+          f"{len(results)} cells clean ({n_bad} failing)")
+    return 1 if n_bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -68,7 +68,7 @@ def main() -> int:
     x = rng.integers(-(2**31), 2**31 - 1, size=30_000, dtype=np.int32)
     ref = np.sort(x)
 
-    print("fault grid: 8 sites x {radix, sample} — must recover verified")
+    print("fault grid: 9 sites x {radix, sample} — must recover verified")
     for site in faults.SITES:
         for algo in ("radix", "sample"):
             env_extra = {}

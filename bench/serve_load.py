@@ -80,6 +80,13 @@ MIN_BATCH_SPEEDUP = 2.0
 #: knob stays latency-leaning).
 BATCH_WINDOW_MS = "8"
 
+#: --chaos leg (ISSUE 11): injected response-delay tail — every Nth
+#: proxied connection's reply is held this long, so the chaos p99 is a
+#: deterministic property of the schedule, not of runner weather.
+CHAOS_DELAY_MS = 400
+CHAOS_EVERY = 4
+CHAOS_REQUESTS = 32
+
 HOST = "127.0.0.1"
 
 
@@ -443,6 +450,62 @@ def record_metrics(stats: dict, speedup: float | None) -> None:
     m.dump(knobs.get("SORT_METRICS"))
 
 
+# ------------------------------------------------------------- chaos leg
+
+def chaos_phase(out: Path, seed: int) -> dict:
+    """p99-under-chaos beside the clean row (ISSUE 11): a fresh server
+    behind the chaos proxy's deterministic injected tail
+    (``wire_delay_response@CHAOS_DELAY_MS:CHAOS_EVERY``), measured
+    twice — plain client, then hedged (``hedge_after_s=0.1``).  Returns
+    the extra row fields (``None`` values when the leg failed)."""
+    from wire_chaos import ChaosProxy
+
+    from mpitest_tpu.serve.client import ResilientClient
+
+    rng = np.random.default_rng(seed + 7000)
+    spec = f"wire_delay_response@{CHAOS_DELAY_MS}:{CHAOS_EVERY}"
+    srv = Server(out, "chaosleg", {"SORT_SERVE_BATCH_WINDOW_MS": "0",
+                                   "SORT_SERVE_SHAPE_BUCKETS": "10"})
+    fields: dict = {"chaos_spec": spec, "p99_chaos_ms": None,
+                    "p99_chaos_hedged_ms": None}
+    try:
+        warm = rng.integers(-2**31, 2**31 - 1, size=512, dtype=np.int32)
+        with ServeClient(HOST, srv.port) as c:
+            if not c.sort(warm).ok:
+                log("chaos leg: warmup failed; skipping")
+                return fields
+
+        def run(hedge: "float | None") -> list[float]:
+            lats: list[float] = []
+            with ChaosProxy(HOST, srv.port, spec) as px:
+                client = ResilientClient(HOST, px.port,
+                                         read_timeout=30.0,
+                                         max_attempts=1,
+                                         hedge_after_s=hedge)
+                for _ in range(CHAOS_REQUESTS):
+                    a = rng.integers(-2**31, 2**31 - 1, size=512,
+                                     dtype=np.int32)
+                    t0 = time.perf_counter()
+                    r = client.sort(a)
+                    lats.append(time.perf_counter() - t0)
+                    if not (r.ok and np.array_equal(r.arr, np.sort(a))):
+                        raise RuntimeError(f"chaos reply bad: {r.header}")
+            return sorted(lats)
+
+        plain = run(None)
+        hedged = run(0.1)
+        fields["p99_chaos_ms"] = round(percentile(plain, 99) * 1e3, 3)
+        fields["p99_chaos_hedged_ms"] = round(
+            percentile(hedged, 99) * 1e3, 3)
+        log(f"chaos leg ({spec}): p99 {fields['p99_chaos_ms']} ms "
+            f"plain vs {fields['p99_chaos_hedged_ms']} ms hedged")
+    except (OSError, ConnectionError, RuntimeError) as e:
+        log(f"chaos leg failed: {e}")
+    finally:
+        srv.stop()
+    return fields
+
+
 # ---------------------------------------------------------------- selftest
 
 def check_leg(tag: str, stats: dict, rc: int, requests: int,
@@ -602,6 +665,11 @@ def main() -> int:
     ap.add_argument("--row", action="store_true",
                     help="measure the batched phase only; emit one "
                          "bench JSON row (bench.py serve row)")
+    ap.add_argument("--chaos", action="store_true",
+                    help="also measure p99 under the chaos proxy's "
+                         "injected response-delay tail, plain AND "
+                         "hedged, recorded in the row beside the "
+                         "clean numbers (ISSUE 11)")
     ap.add_argument("--out", default="/tmp/mpitest_serve_load",
                     help="artifact dir (server traces)")
     ap.add_argument("--requests", type=int, default=160)
@@ -627,9 +695,12 @@ def main() -> int:
         for e in stats["metrics_errors"]:
             log(f"[FAIL] {e}")
         return 1
-    emit_row(stats, {"concurrency": args.concurrency,
-                     "dispatch_mkeys_per_s":
-                     round(dispatch_mkeys_per_s(spans), 3)})
+    extra = {"concurrency": args.concurrency,
+             "dispatch_mkeys_per_s":
+             round(dispatch_mkeys_per_s(spans), 3)}
+    if args.chaos:
+        extra.update(chaos_phase(out, args.seed))
+    emit_row(stats, extra)
     record_metrics(stats, None)
     return 0
 

@@ -79,6 +79,11 @@ def main(argv: list[str] | None = None) -> int:
             "SORT_SERVE_BATCH_WINDOW_MS", "SORT_SERVE_BATCH_KEYS",
             "SORT_SERVE_SHAPE_BUCKETS", "SORT_SERVE_PREWARM",
             "SORT_SERVE_ALLOW_FAULTS",
+            # the request-lifecycle robustness layer (ISSUE 11)
+            "SORT_SERVE_IDLE_TIMEOUT_S", "SORT_SERVE_READ_TIMEOUT_S",
+            "SORT_SERVE_DISPATCH_TIMEOUT_S",
+            "SORT_SERVE_BREAKER_BACKOFF_S",
+            "SORT_SERVE_COMPLETION_TIMEOUT_S", "SORT_FAULT_STALL_MS",
             # the live-telemetry layer (ISSUE 10)
             "SORT_TRACE_SAMPLE", "SORT_FLIGHT_RECORDER_SIZE",
             "SORT_FLIGHT_RECORDER_DIR", "SORT_PROFILE",
@@ -104,6 +109,12 @@ def main(argv: list[str] | None = None) -> int:
 
     core = ServerCore()
     core.prewarm(log)
+    # dispatch watchdog (ISSUE 11): monitors the single dispatch
+    # thread's heartbeat; a dispatch past SORT_SERVE_DISPATCH_TIMEOUT_S
+    # trips the circuit breaker (healthz 503, fast typed rejections,
+    # flight-recorder artifact) and half-opens with a probe after
+    # backoff.  0 disables.
+    core.start_watchdog()
     try:
         server = SortServer(core, host, port)
     except OSError as e:
@@ -161,10 +172,28 @@ def main(argv: list[str] | None = None) -> int:
     if telemetry is not None:
         telemetry.shutdown()
         telemetry.server_close()
+    if not drained:
+        # ISSUE 11 satellite: a drain timeout is an INCIDENT, not a
+        # quiet log line — name the stuck requests, record the typed
+        # drain_timeout evidence (span event -> live counter via the
+        # bridge), dump the flight recorder, exit dirty.
+        import time as _time
+
+        from mpitest_tpu.utils import flight_recorder
+
+        stuck = core.stuck_trace_ids()
+        core.tracer.spans.record(
+            "serve.watchdog", _time.perf_counter(), 0.0,
+            event="drain_timeout", trace_ids=stuck)
+        path = flight_recorder.get().dump("drain_timeout")
+        log(f"drain TIMEOUT: {len(stuck)} request(s) still in flight "
+            f"(trace_ids={stuck}); flight recorder dumped to "
+            f"{path or '(nothing)'}")
     log(f"drained={'clean' if drained else 'TIMEOUT'} "
         f"served_ok={core.requests_ok} errors={core.requests_err} "
         f"rejected={core.admission.rejected} "
         f"batches={core.batcher.batches} "
+        f"watchdog_trips={core.breaker.trips} "
         f"cache_hits={core.cache.stats.hits} "
         f"cache_misses={core.cache.stats.misses}")
     return 0 if drained else 1

@@ -34,11 +34,24 @@ both algorithms):
 ``cap_squeeze``     force the first exchange cap to the alignment minimum
 ``ingest_poison``   corrupt an encoded ingest chunk AFTER the input
                     fingerprint folded it (streamed ingest only)
+``dispatch_stall``  block the dispatch thread for ``SORT_FAULT_STALL_MS``
+                    before launching (the serving watchdog's drill:
+                    models the TPU-compiler tunnel hang)
 ``result_swap``     swap the first/last keys of the sorted result
                     (breaks sortedness — caught by the order check)
 ``result_dup``      overwrite key[1] with key[0] (stays sorted — caught
                     ONLY by the multiset fingerprint)
 ================  ==========================================================
+
+Wire-level chaos (ISSUE 11) is a separate family: :data:`WIRE_SITES`
+name faults injected OUTSIDE the process by the chaos TCP proxy
+(``bench/wire_chaos.py``) between a client and the sort server — torn
+headers, stalled payloads, mid-response disconnects, slow-drip writes,
+delayed responses, connect-then-silence.  They share this module's
+spec grammar (:func:`parse_wire_faults`) so one vocabulary covers the
+whole chaos surface, but they never corrupt *data*: they attack the
+server's request-lifecycle bounds (read/idle timeouts, admission-byte
+reclamation) and the client's retry/hedging policy instead.
 
 Injection never bypasses detection: faults corrupt *data*, and the
 always-on verifier (``models/verify.py``) plus the supervisor decide
@@ -67,6 +80,7 @@ if TYPE_CHECKING:
 SITES = (
     "dispatch_error",
     "dispatch_oom",
+    "dispatch_stall",
     "exchange_corrupt",
     "exchange_drop",
     "cap_squeeze",
@@ -80,6 +94,105 @@ SITES = (
 #: ``fault_token`` so the poisoned trace can never be served from the
 #: jit cache to a clean run.
 EXCHANGE_SITES = ("exchange_corrupt", "exchange_drop")
+
+#: Wire-level chaos vocabulary (ISSUE 11): injected by the chaos TCP
+#: proxy (``bench/wire_chaos.py``) between client and server.  Each
+#: site carries one integer parameter (a byte offset ``k`` or a delay
+#: in milliseconds — see ``WIRE_DEFAULT_PARAM``).
+WIRE_SITES = (
+    "wire_torn_header",         # forward k request bytes, then close
+    "wire_stall_payload",       # forward header + k payload bytes, then
+                                # go silent (the slow-loris shape)
+    "wire_disconnect_response", # forward k response bytes, then close
+    "wire_slow_drip",           # drip request bytes with k ms pauses
+    "wire_delay_response",      # hold the response back for k ms
+    "wire_connect_silence",     # accept the client, forward nothing
+)
+
+#: Per-site default parameter (bytes for the offset sites, ms for the
+#: delay sites) when the spec names no ``@param``.
+WIRE_DEFAULT_PARAM: dict[str, int] = {
+    "wire_torn_header": 5,
+    "wire_stall_payload": 64,
+    "wire_disconnect_response": 16,
+    "wire_slow_drip": 200,
+    "wire_delay_response": 500,
+    "wire_connect_silence": 0,
+}
+
+
+@dataclass(frozen=True)
+class WireFault:
+    """One parsed wire-fault entry: ``site[@param][:every]``.
+
+    ``param`` is the site's byte offset / delay ms; ``every`` selects
+    which proxied connections the fault fires on — every ``every``-th
+    (1-based), so ``every=1`` hits all connections and ``every=4``
+    hits the 4th, 8th, ... (deterministic tail injection for the
+    hedging cells)."""
+
+    site: str
+    param: int
+    every: int = 1
+
+    def spec(self) -> str:
+        """The canonical spec string (``parse_wire_faults`` round-trips
+        it)."""
+        out = self.site
+        if self.param != WIRE_DEFAULT_PARAM[self.site]:
+            out += f"@{self.param}"
+        if self.every != 1:
+            out += f":{self.every}"
+        return out
+
+    def fires_on(self, conn_index: int) -> bool:
+        """True when this fault applies to the ``conn_index``-th
+        (0-based) proxied connection."""
+        return (conn_index + 1) % self.every == 0
+
+
+def parse_wire_faults(spec: str) -> tuple[WireFault, ...]:
+    """Parse a comma list of ``site[@param][:every]`` wire-fault
+    entries (the ``SORT_FAULTS``-style grammar extended with the wire
+    family).  Raises ``ValueError`` naming the vocabulary on garbage —
+    the same fail-fast contract as :class:`FaultRegistry`."""
+    out: list[WireFault] = []
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        name, _, every_s = entry.partition(":")
+        name, _, param_s = name.partition("@")
+        if name not in WIRE_SITES:
+            raise ValueError(
+                f"wire faults: unknown site {name!r}; use one of "
+                f"{WIRE_SITES}")
+        param = WIRE_DEFAULT_PARAM[name]
+        if param_s:
+            try:
+                param = int(param_s)
+            except ValueError:
+                param = -1
+            if param < 0:
+                raise ValueError(
+                    f"wire faults: bad param {param_s!r} for {name!r}; "
+                    "use an integer >= 0 (bytes or ms)")
+        every = 1
+        if every_s:
+            try:
+                every = int(every_s)
+            except ValueError:
+                every = 0
+            if every < 1:
+                raise ValueError(
+                    f"wire faults: bad every-count {every_s!r} for "
+                    f"{name!r}; use an integer >= 1")
+        out.append(WireFault(name, param, every))
+    if not out:
+        raise ValueError(
+            f"wire faults: empty spec; use a comma list of "
+            f"site[@param][:every] over {WIRE_SITES}")
+    return tuple(out)
 
 
 def _splitmix64(state: int) -> tuple[int, int]:

@@ -151,6 +151,14 @@ class SortSupervisor:
         reg = self.registry
         if reg is None:
             return
+        if reg.would_fire("dispatch_stall"):
+            stall_ms = knobs.get("SORT_FAULT_STALL_MS")
+            if reg.fire("dispatch_stall", stall_ms=stall_ms):
+                # models the known wedge (the TPU-compiler tunnel hang):
+                # the SINGLE dispatch thread blocks here, which is
+                # exactly what the serving watchdog exists to detect —
+                # the sort itself still completes correctly afterwards
+                time.sleep(stall_ms / 1e3)
         if reg.fire("dispatch_oom"):
             raise jax.errors.JaxRuntimeError(
                 "RESOURCE_EXHAUSTED: injected fault (SORT_FAULTS=dispatch_oom)")

@@ -51,7 +51,10 @@ from mpitest_tpu.utils.span_schema import (BALANCE_SPAN,
                                            RESTAGE_SPAN, RETRY_SPAN,
                                            SERVE_BATCH_SPAN,
                                            SERVE_CACHE_SPAN,
+                                           SERVE_DEADLINE_SPAN,
+                                           SERVE_HEDGE_SPAN,
                                            SERVE_REQUEST_SPAN,
+                                           SERVE_WATCHDOG_SPAN,
                                            TRACE_ID_ATTR, VERIFY_SPAN)
 from mpitest_tpu.utils.spans import (MPI_EQUIV, SCHEMA as SPAN_SCHEMA,
                                      merge_intervals, overlap_seconds)
@@ -152,7 +155,9 @@ def aggregate(rows: list[dict]) -> dict:
     # serve.compile_cache point event per executor-cache lookup.
     serve = {"requests": [], "batches": 0, "batch_segments": 0,
              "batch_keys": 0, "cache_hits": 0, "cache_misses": 0,
-             "compile_s": 0.0}
+             "compile_s": 0.0,
+             # request-lifecycle robustness events (ISSUE 11)
+             "deadline_expired": {}, "watchdog": {}, "hedges": 0}
     # scale-out events (ISSUE 7): one exchange_balance event per
     # negotiated exchange (per-rank send/recv bytes, negotiated vs
     # worst-case capacity) + the restage count — the evidence row of
@@ -224,6 +229,16 @@ def aggregate(rows: list[dict]) -> dict:
                     serve["cache_misses"] += 1
                     serve["compile_s"] += float(a.get("compile_s", 0.0)
                                                 or 0.0)
+            elif name == SERVE_DEADLINE_SPAN:
+                stage = str(obj.get("attrs", {}).get("stage", "?"))
+                serve["deadline_expired"][stage] = \
+                    serve["deadline_expired"].get(stage, 0) + 1
+            elif name == SERVE_WATCHDOG_SPAN:
+                event = str(obj.get("attrs", {}).get("event", "?"))
+                serve["watchdog"][event] = \
+                    serve["watchdog"].get(event, 0) + 1
+            elif name == SERVE_HEDGE_SPAN:
+                serve["hedges"] += 1
             elif name == VERIFY_SPAN:
                 robust["verify_runs"] += 1
                 if not obj.get("attrs", {}).get("ok", True):
@@ -381,6 +396,9 @@ def serve_slo(serve: dict,
         "cache_hits": serve.get("cache_hits", 0),
         "cache_misses": serve.get("cache_misses", 0),
         "compile_s": round(serve.get("compile_s", 0.0), 4),
+        "deadline_expired": dict(serve.get("deadline_expired") or {}),
+        "watchdog": dict(serve.get("watchdog") or {}),
+        "hedges": serve.get("hedges", 0),
     }
     out.update(error_budget(len(reqs), len(reqs) - len(ok), slo_target))
     return out
@@ -713,6 +731,17 @@ def render(agg: dict, slo_target: float = DEFAULT_SLO_TARGET_PCT) -> str:
         out.append(f"  executor cache: {slo['cache_hits']} hits, "
                    f"{slo['cache_misses']} misses "
                    f"({slo['compile_s']}s compiling)")
+        # request-lifecycle robustness lines (ISSUE 11), only when the
+        # events occurred — a clean run's table stays byte-unchanged
+        if slo["deadline_expired"]:
+            out.append("  deadlines expired pre-dispatch: " + ", ".join(
+                f"{stage}={n}" for stage, n in
+                sorted(slo["deadline_expired"].items())))
+        if slo["watchdog"]:
+            out.append("  watchdog: " + ", ".join(
+                f"{ev}={n}" for ev, n in sorted(slo["watchdog"].items())))
+        if slo["hedges"]:
+            out.append(f"  client hedges: {slo['hedges']}")
     rb = agg.get("robustness") or {}
     if any(rb.get(k) for k in ("faults", "retries", "verify_runs")):
         out.append("")

@@ -19,7 +19,11 @@ import threading
 
 class AdmissionReject(RuntimeError):
     """Typed backpressure rejection.  ``reason`` ∈ {"inflight",
-    "bytes", "draining"}; the wire protocol forwards it verbatim."""
+    "bytes", "draining", "breaker"}; the wire protocol forwards it
+    verbatim.  ("breaker" is raised by ``ServerCore._admit`` when the
+    dispatch watchdog's circuit breaker is open — ISSUE 11 — and is
+    tallied here via :meth:`AdmissionControl.note_reject` so the
+    rejected count covers breaker-open incidents too.)"""
 
     def __init__(self, reason: str, detail: str) -> None:
         super().__init__(detail)
@@ -85,6 +89,13 @@ class AdmissionControl:
             self._changed()
             if self.inflight == 0:
                 self._idle.notify_all()
+
+    def note_reject(self) -> None:
+        """Count a rejection decided OUTSIDE this gate (the circuit
+        breaker's fast path) so ``rejected`` stays the one total the
+        driver's exit line and /varz report."""
+        with self._lock:
+            self.rejected += 1
 
     def snapshot(self) -> dict:
         """Point-in-time state for the live /varz endpoint (ISSUE 10)."""

@@ -36,6 +36,12 @@ if TYPE_CHECKING:
 #: Sentinel that tells the dispatch thread to finish the queue and exit.
 _STOP = object()
 
+#: Typed error code of a request whose deadline expired before dispatch
+#: (ISSUE 11).  Defined here (not in server.py) because the dispatch
+#: loop is the layer that cancels expired work; the wire protocol
+#: re-exports it as part of the stable error vocabulary.
+ERR_DEADLINE = "deadline_exceeded"
+
 
 @dataclass
 class ServeRequest:
@@ -62,10 +68,32 @@ class ServeRequest:
     #: seconds between enqueue and dispatch pickup (the queue wait the
     #: serve.request span + live histogram report).
     queue_s: float | None = None
+    #: absolute monotonic deadline (ISSUE 11): work whose deadline
+    #: expires before dispatch is cancelled typed, never dispatched.
+    deadline: float | None = None
+    #: lifecycle stage the deadline expired at (admission|queue|
+    #: dispatch) — set by fail_deadline, read by the serve.deadline
+    #: span emission.
+    deadline_stage: str | None = None
 
     @property
     def n(self) -> int:
         return int(self.arr.size)
+
+    def expired(self, now: float | None = None) -> bool:
+        """True once the request's deadline has passed (False when no
+        deadline was set)."""
+        if self.deadline is None:
+            return False
+        return (time.monotonic() if now is None else now) >= self.deadline
+
+    def fail_deadline(self, stage: str) -> None:
+        """Cancel this request typed ``deadline_exceeded`` — it was
+        never dispatched; the admitting handler releases its bytes."""
+        self.deadline_stage = stage
+        self.fail(ERR_DEADLINE,
+                  f"deadline expired before dispatch (at stage "
+                  f"{stage!r}); the sort was never run")
 
     def picked_up(self) -> None:
         """Dispatch-thread pickup marker: fixes the queue wait."""
@@ -99,10 +127,18 @@ class Batcher:
         self.batch_keys = int(batch_keys)
         self._q: "queue.Queue[object]" = queue.Queue()
         self._pending: list[ServeRequest] = []  # incompatibles set aside
+        self._pending_lock = threading.Lock()
         self._stopping = False
         self.batches = 0
         self.batched_requests = 0
         self.solo_requests = 0
+        self.deadline_cancelled = 0
+        #: dispatch heartbeat (ISSUE 11): (monotonic start, kind,
+        #: trace_ids) while an executor call is live, None otherwise —
+        #: the watchdog's only evidence, so it is set/cleared under a
+        #: lock around EVERY executor call.
+        self._hb_lock = threading.Lock()
+        self._hb: "tuple[float, str, list[str]] | None" = None
         self._thread = threading.Thread(target=self._loop,
                                         name="serve-dispatch", daemon=True)
         self._thread.start()
@@ -110,14 +146,57 @@ class Batcher:
     def submit(self, req: ServeRequest) -> None:
         self._q.put(req)
 
+    # -- watchdog surface (ISSUE 11) ----------------------------------
+    def inflight_dispatch(self) -> "tuple[float, str, list[str]] | None":
+        """Snapshot of the live executor call: ``(age_s, kind,
+        trace_ids)`` — None when the dispatch thread is between
+        dispatches.  The watchdog polls this."""
+        with self._hb_lock:
+            hb = self._hb
+        if hb is None:
+            return None
+        started, kind, tids = hb
+        return (time.monotonic() - started, kind, tids)
+
+    def fail_queued(self, code: str, detail: str) -> int:
+        """Fail every request still waiting in the queue (typed) —
+        called by the watchdog when the dispatch thread is wedged, so
+        queued callers stop burning their completion timeout on work
+        that will never start.  Returns the number failed."""
+        failed = 0
+        drained: list[object] = []
+        while True:
+            try:
+                drained.append(self._q.get_nowait())
+            except queue.Empty:
+                break
+        for item in drained:
+            if isinstance(item, ServeRequest):
+                if not item.done.is_set():
+                    item.fail(code, detail)
+                    failed += 1
+            else:
+                self._q.put(item)        # _STOP survives the purge
+        with self._pending_lock:
+            pending, self._pending = self._pending, []
+        for req in pending:
+            if not req.done.is_set():
+                req.fail(code, detail)
+                failed += 1
+        return failed
+
     def _guarded(self, thunk: "Callable[[], None]",
-                 reqs: list[ServeRequest]) -> None:
+                 reqs: list[ServeRequest], kind: str) -> None:
         """Run an executor under a blanket guard: the dispatch thread
         must survive ANY executor failure (the executors are typed
         internally, but e.g. a span-stream disk-full OSError escaping
         would otherwise kill the only thread that completes requests,
         wedging every future request for the full completion timeout).
-        Requests the executor never completed fail typed instead."""
+        Requests the executor never completed fail typed instead.  The
+        heartbeat brackets the call so the watchdog can age it."""
+        with self._hb_lock:
+            self._hb = (time.monotonic(), kind,
+                        [r.trace_id for r in reqs])
         try:
             thunk()
         except BaseException as e:  # noqa: BLE001 — thread survival
@@ -125,21 +204,51 @@ class Batcher:
                 if not r.done.is_set():
                     r.fail("internal",
                            f"dispatcher error: {type(e).__name__}: {e}")
+        finally:
+            with self._hb_lock:
+                self._hb = None
 
-    def stop(self, timeout: float = 60.0) -> None:
+    def stop(self, timeout: float = 60.0) -> bool:
         """Finish everything already enqueued, then stop the dispatch
-        thread (the drain path: admission already rejects new work)."""
+        thread (the drain path: admission already rejects new work).
+        Returns True iff the thread actually exited inside ``timeout``
+        — a False here means a dispatch is wedged, and the caller
+        (``ServerCore.drain_and_stop``) must NOT report a clean drain
+        (the silently-discarded join() outcome, ISSUE 11)."""
         self._q.put(_STOP)
         self._thread.join(timeout)
+        return not self._thread.is_alive()
 
     # -- dispatch loop ------------------------------------------------
     def _next(self, timeout: float | None) -> object | None:
-        if self._pending:
-            return self._pending.pop(0)
+        with self._pending_lock:
+            if self._pending:
+                return self._pending.pop(0)
         try:
             return self._q.get(timeout=timeout)
         except queue.Empty:
             return None
+
+    @staticmethod
+    def _deadline_close(req: ServeRequest, now: float) -> float:
+        """Window-close instant for a deadline-carrying member: 90% of
+        its remaining budget (>= 2 ms headroom), so the dispatch still
+        happens inside the deadline instead of the pack consuming it
+        to the last tick."""
+        assert req.deadline is not None
+        return req.deadline - max(0.002, 0.1 * (req.deadline - now))
+
+    def _cancel_if_expired(self, req: ServeRequest, stage: str) -> bool:
+        """Deadline gate at queue pickup (ISSUE 11): expired work is
+        cancelled typed and NEVER dispatched — the device's time goes
+        to requests someone is still waiting for.  (The executors run
+        a final stage="dispatch" check at entry; this one catches the
+        queue wait.)"""
+        if req.expired():
+            req.fail_deadline(stage)
+            self.deadline_cancelled += 1
+            return True
+        return False
 
     def _loop(self) -> None:
         while True:
@@ -154,16 +263,28 @@ class Batcher:
             req = item  # type: ignore[assignment]
             if not isinstance(req, ServeRequest):
                 continue
+            if self._cancel_if_expired(req, "queue"):
+                continue
             if not req.batchable or req.faults is not None:
                 self.solo_requests += 1
-                self._guarded(lambda r=req: self.run_solo(r), [req])
+                self._guarded(lambda r=req: self.run_solo(r), [req],
+                              "solo")
                 continue
             batch = [req]
             total = req.n
             if self.window_s > 0:
-                deadline = time.monotonic() + self.window_s
+                # the window closes at the EARLIEST member deadline,
+                # less dispatch headroom (10% of the member's remaining
+                # budget, >= 2 ms): holding a deadline-carrying request
+                # open for the full window could expire it in the pack,
+                # and closing exactly AT the deadline would hand the
+                # dispatch a request already dead on arrival
+                now = time.monotonic()
+                close = now + self.window_s
+                if req.deadline is not None:
+                    close = min(close, self._deadline_close(req, now))
                 while total < self.batch_keys:
-                    slack = deadline - time.monotonic()
+                    slack = close - time.monotonic()
                     if slack <= 0:
                         break
                     try:
@@ -174,23 +295,40 @@ class Batcher:
                         self._stopping = True
                         continue
                     cand = nxt  # type: ignore[assignment]
-                    if (isinstance(cand, ServeRequest) and cand.batchable
-                            and cand.faults is None
+                    if not isinstance(cand, ServeRequest):
+                        continue
+                    if self._cancel_if_expired(cand, "queue"):
+                        continue
+                    if (cand.batchable and cand.faults is None
                             and cand.dtype == req.dtype
                             and total + cand.n <= self.batch_keys):
                         batch.append(cand)
                         total += cand.n
+                        if cand.deadline is not None:
+                            close = min(close, self._deadline_close(
+                                cand, time.monotonic()))
                     else:
                         # incompatible (dtype mix, solo-only, or the
                         # batch would overflow): set it aside for the
                         # next iteration and close this batch — simple
                         # FIFO fairness beats clever repacking at a
                         # 2 ms window
-                        self._pending.append(cand)  # type: ignore[arg-type]
+                        with self._pending_lock:
+                            self._pending.append(cand)
                         break
+            # final deadline sweep AFTER the window: members that
+            # expired while the pack collected are cancelled here, so
+            # the batches/batched_requests tallies below count only
+            # work actually handed to the executor (they must
+            # reconcile with the serve.batch span stream)
+            batch = [r for r in batch
+                     if not self._cancel_if_expired(r, "dispatch")]
+            if not batch:
+                continue
             # window 0 degenerates to per-request dispatch — still
             # through the packed path, so the executor cache serves the
             # sequential mode warm too (the A/B the selftest measures)
             self.batches += 1
             self.batched_requests += len(batch)
-            self._guarded(lambda b=batch: self.run_batch(b), batch)
+            self._guarded(lambda b=batch: self.run_batch(b), batch,
+                          "batch")

@@ -16,11 +16,22 @@ requests back to back):
   the sorted key bytes, or ``{"ok": false, "error": <code>, "detail":
   ..., "trace_id": ...}`` with no payload.  Error codes are TYPED and stable: ``bad_request`` (the
   header/payload is malformed), ``backpressure`` (admission bounds hit
-  — retry with backoff), ``draining`` (SIGTERM received), ``integrity``
-  (no path produced a verified result for THIS request),
-  ``retries`` (dispatch kept failing past the retry budget),
+  or the circuit breaker is open — retry with backoff), ``draining``
+  (SIGTERM received), ``deadline_exceeded`` (the request's optional
+  ``deadline_ms`` budget expired before dispatch — the sort was never
+  run), ``integrity`` (no path produced a verified result for THIS
+  request), ``retries`` (dispatch kept failing past the retry budget),
   ``internal`` (anything else — still one request's problem, never the
   server's).
+
+Request lifecycle bounds (ISSUE 11): the header read is bounded by the
+connection idle timeout, payload reads / rejected-payload drains /
+response writes by one total ``SORT_SERVE_READ_TIMEOUT_S`` budget
+(admission bytes are provably released on every wire exit path), the
+dispatch wait by ``SORT_SERVE_COMPLETION_TIMEOUT_S``, and the dispatch
+itself by the watchdog (``serve/watchdog.py``): a wedge trips a
+circuit breaker — ``/healthz`` 503, fast typed rejections, automatic
+half-open probe recovery — instead of silently pinning the server.
 
 Failure semantics: every dispatch runs under the PR 3 robustness layer.
 Solo requests go through the supervised ``models.api.sort`` (bounded
@@ -42,8 +53,10 @@ ordinary ``SORT_TRACE`` stream.
 from __future__ import annotations
 
 import json
+import math
 import os
 import re
+import socket
 import socketserver
 import threading
 import time
@@ -55,9 +68,10 @@ from mpitest_tpu import faults
 from mpitest_tpu.models import segmented
 from mpitest_tpu.models import supervisor as supervision
 from mpitest_tpu.serve.admission import AdmissionControl, AdmissionReject
-from mpitest_tpu.serve.batching import Batcher, ServeRequest
+from mpitest_tpu.serve.batching import ERR_DEADLINE, Batcher, ServeRequest
 from mpitest_tpu.serve.executor_cache import ExecutorCache
 from mpitest_tpu.serve.telemetry import ProfileHook
+from mpitest_tpu.serve.watchdog import CircuitBreaker, DispatchWatchdog
 from mpitest_tpu.utils import flight_recorder, knobs
 from mpitest_tpu.utils import spans as spanlib
 from mpitest_tpu.utils.metrics_live import LiveMetrics, SpanMetricsBridge
@@ -77,16 +91,15 @@ ERR_DRAINING = "draining"
 ERR_INTEGRITY = "integrity"
 ERR_RETRIES = "retries"
 ERR_INTERNAL = "internal"
+#: ISSUE 11: the request's deadline_ms expired before dispatch — the
+#: sort was never run, the admission bytes were released.  Defined by
+#: the dispatch layer (serve/batching.py), re-exported as wire vocab.
+ERR_DEADLINE_EXCEEDED = ERR_DEADLINE
 
 #: Sanity cap on a single request's key count (the admission byte bound
 #: is the real limit; this just stops a hostile header from asking the
 #: server to read exabytes to keep framing).
 MAX_REQUEST_KEYS = 1 << 31
-
-#: Completion backstop: a request whose dispatch never completes (a
-#: dispatcher bug — should be impossible) fails typed instead of
-#: hanging its connection forever.
-_COMPLETION_TIMEOUT_S = 600.0
 
 #: Wire-supplied trace ids: short, log/filename-safe tokens.  Anything
 #: else is a typed bad_request — trace ids land in span attrs, file
@@ -146,6 +159,12 @@ class ServerCore:
         self.allow_faults = knobs.get("SORT_SERVE_ALLOW_FAULTS")
         self.batch_keys = knobs.get("SORT_SERVE_BATCH_KEYS")
         window_ms = knobs.get("SORT_SERVE_BATCH_WINDOW_MS")
+        # request-lifecycle bounds (ISSUE 11): every wire interaction
+        # and every dispatch wait is time-bounded
+        self.idle_timeout_s = knobs.get("SORT_SERVE_IDLE_TIMEOUT_S")
+        self.read_timeout_s = knobs.get("SORT_SERVE_READ_TIMEOUT_S")
+        self.completion_timeout_s = knobs.get(
+            "SORT_SERVE_COMPLETION_TIMEOUT_S")
         self.cache = ExecutorCache(self.tracer.spans)
         self.admission = AdmissionControl(
             knobs.get("SORT_SERVE_MAX_INFLIGHT"),
@@ -165,11 +184,29 @@ class ServerCore:
         self._batch_seq = 0
         self.batcher = Batcher(self._run_batch, self._run_solo,
                                window_ms / 1e3, self.batch_keys)
+        #: circuit breaker + dispatch watchdog (ISSUE 11).  The breaker
+        #: is always consulted by admission; the watchdog THREAD only
+        #: runs when start_watchdog() is called (the server driver does;
+        #: in-process test cores stay thread-clean unless they opt in).
+        self.breaker = CircuitBreaker(
+            knobs.get("SORT_SERVE_BREAKER_BACKOFF_S"))
+        self.watchdog = DispatchWatchdog(
+            self, knobs.get("SORT_SERVE_DISPATCH_TIMEOUT_S"),
+            self.breaker)
         self.requests_ok = 0
         self.requests_err = 0
         #: guards the two tallies above — _finish runs on concurrent
         #: TCP handler threads, and a bare += loses increments.
         self._tally_lock = threading.Lock()
+        #: in-flight dispatched requests by trace_id (ISSUE 11): the
+        #: drain-timeout path names exactly who was stuck.
+        self._inflight_reqs: dict[str, ServeRequest] = {}
+        self._inflight_lock = threading.Lock()
+
+    def start_watchdog(self) -> None:
+        """Start the dispatch-watchdog thread (no-op when
+        ``SORT_SERVE_DISPATCH_TIMEOUT_S=0``)."""
+        self.watchdog.start()
 
     def _publish_admission(self, inflight: int, nbytes: int) -> None:
         self.metrics.gauge("sort_serve_inflight").set(inflight)
@@ -196,6 +233,12 @@ class ServerCore:
         from mpitest_tpu.models import api
 
         req.picked_up()
+        if req.expired():
+            # final pre-executor deadline gate (stage "dispatch"): the
+            # device never sees work nobody is waiting for
+            req.fail_deadline("dispatch")
+            self.batcher.deadline_cancelled += 1
+            return
         reg = None
         if req.faults is not None:
             reg = faults.FaultRegistry(req.faults, seed=faults.faults_seed())
@@ -235,11 +278,19 @@ class ServerCore:
         from mpitest_tpu.models import api
 
         t0 = time.perf_counter()
+        for r in list(reqs):
+            r.picked_up()
+            if r.expired():
+                # a member that expired while the window packed around
+                # it is cancelled here; its batchmates dispatch normally
+                r.fail_deadline("dispatch")
+                self.batcher.deadline_cancelled += 1
+                reqs.remove(r)
+        if not reqs:
+            return
         dtype = reqs[0].dtype
         self._batch_seq += 1
         batch_id = f"b{os.getpid():x}-{self._batch_seq:06x}"
-        for r in reqs:
-            r.picked_up()
         with spanlib.trace_context(batch_id=batch_id):
             try:
                 with self.profiler.maybe_capture():
@@ -308,9 +359,16 @@ class ServerCore:
     def reject_code(e: AdmissionReject) -> str:
         return ERR_DRAINING if e.reason == "draining" else ERR_BACKPRESSURE
 
+    def _deadline_event(self, stage: str, trace_id: str) -> None:
+        """Record the registered ``serve.deadline`` point event — the
+        audit trail (and live counter, via the span bridge) of work
+        cancelled before it ever reached the device."""
+        self.tracer.spans.record("serve.deadline", time.perf_counter(),
+                                 0.0, stage=stage, trace_id=trace_id)
+
     def _dispatch_admitted(self, t0: float, attrs: dict, arr: np.ndarray,
                            algo: str | None, faults_spec: str | None,
-                           trace_id: str,
+                           trace_id: str, deadline: float | None = None,
                            ) -> tuple[str, Any, dict]:
         """Dispatch an ALREADY-ADMITTED request and wait for completion.
         The caller owns the admission release."""
@@ -319,11 +377,24 @@ class ServerCore:
             algo=algo or self.default_algo,
             batchable=(faults_spec is None
                        and int(arr.size) <= self.batch_keys),
-            faults=faults_spec, trace_id=trace_id)
-        self.batcher.submit(req)
-        if not req.done.wait(_COMPLETION_TIMEOUT_S):
-            return self._finish(t0, attrs, ERR_INTERNAL,
-                                "dispatch timed out")
+            faults=faults_spec, trace_id=trace_id, deadline=deadline)
+        if req.expired():
+            # stage "admission": the deadline died while the payload
+            # was read/admitted — never enqueued, never dispatched
+            req.fail_deadline("admission")
+            attrs["deadline_stage"] = "admission"
+            self._deadline_event("admission", trace_id)
+            return self._finish(t0, attrs, req.error[0], req.error[1])
+        with self._inflight_lock:
+            self._inflight_reqs[trace_id] = req
+        try:
+            self.batcher.submit(req)
+            if not req.done.wait(self.completion_timeout_s):
+                return self._finish(t0, attrs, ERR_INTERNAL,
+                                    "dispatch timed out")
+        finally:
+            with self._inflight_lock:
+                self._inflight_reqs.pop(trace_id, None)
         attrs["batched"] = req.batched
         if req.bucket is not None:
             attrs["bucket"] = req.bucket
@@ -332,12 +403,36 @@ class ServerCore:
         if req.queue_s is not None:
             attrs["queue_s"] = round(req.queue_s, 6)
         if req.error is not None:
+            if req.error[0] == ERR_DEADLINE_EXCEEDED:
+                attrs["deadline_stage"] = req.deadline_stage
+                self._deadline_event(req.deadline_stage or "queue",
+                                     trace_id)
             return self._finish(t0, attrs, req.error[0], req.error[1])
         return self._finish(t0, attrs, "ok", req.result)
+
+    def stuck_trace_ids(self) -> list[str]:
+        """Trace ids of requests admitted+dispatched but not yet
+        complete — what the drain-timeout incident artifact names."""
+        with self._inflight_lock:
+            return sorted(self._inflight_reqs)
+
+    def _admit(self, nbytes: int) -> None:
+        """Admission with the circuit breaker consulted FIRST (ISSUE
+        11): while the breaker is open a request is rejected in
+        microseconds — clients back off instead of queueing behind a
+        wedged dispatch."""
+        if self.breaker.engaged():
+            self.admission.note_reject()
+            raise AdmissionReject(
+                "breaker",
+                "circuit breaker open (dispatch watchdog tripped); "
+                "retry with backoff")
+        self.admission.admit(nbytes)
 
     def execute(self, arr: np.ndarray, algo: str | None = None,
                 faults_spec: str | None = None,
                 trace_id: str | None = None,
+                deadline_ms: float | None = None,
                 ) -> tuple[str, Any, dict]:
         """Admit, dispatch and complete one request (the in-process
         entry; the wire path admits BEFORE materializing the payload —
@@ -345,48 +440,173 @@ class ServerCore:
         where status ``"ok"`` carries the sorted array and any error
         status carries the detail string.  ``trace_id`` is minted when
         the caller supplies none; it lands in ``attrs`` and on every
-        span the request touches."""
+        span the request touches.  ``deadline_ms`` (optional) is the
+        caller's remaining latency budget: once it expires the request
+        is cancelled typed ``deadline_exceeded`` at whatever lifecycle
+        stage it had reached — never dispatched late."""
         t0 = time.perf_counter()
         tid = trace_id or mint_trace_id()
+        deadline = (time.monotonic() + deadline_ms / 1e3
+                    if deadline_ms is not None else None)
         nbytes = int(arr.nbytes)
         attrs: dict = {"n": int(arr.size), "dtype": str(arr.dtype),
                        "trace_id": tid}
         try:
-            self.admission.admit(nbytes)
+            self._admit(nbytes)
         except AdmissionReject as e:
             attrs["reject"] = e.reason
             return self._finish(t0, attrs, self.reject_code(e), str(e))
         try:
             return self._dispatch_admitted(t0, attrs, arr, algo,
-                                           faults_spec, tid)
+                                           faults_spec, tid, deadline)
         finally:
             self.admission.release(nbytes)
 
     # -- wire handling ------------------------------------------------
-    @staticmethod
-    def _discard(rfile: BinaryIO, nbytes: int) -> bool:
-        """Read and drop ``nbytes`` of payload in bounded chunks —
-        keeps the connection's framing after a semantic rejection
-        WITHOUT ever buffering the rejected payload (the admission
-        byte bound must bound memory, not just dispatch).  Returns
-        False on a short read (framing lost)."""
-        left = nbytes
-        while left > 0:
-            got = rfile.read(min(left, 1 << 20))
-            if not got:
-                return False
-            left -= len(got)
-        return True
+    def wire_timeout(self, kind: str) -> None:
+        """Tally one enforced wire timeout (kind: idle|read|write) —
+        the live evidence a slow-loris is being shed, not served."""
+        self.metrics.counter("sort_serve_timeouts_total").inc(
+            1, kind=kind)
 
-    def handle_wire(self, header_line: bytes,
-                    rfile: BinaryIO) -> tuple[dict, bytes, bool]:
+    def _read_wire(self, rfile: BinaryIO, nbytes: int,
+                   conn: "socket.socket | None",
+                   keep: bool = True) -> tuple[bytes, str]:
+        """Read exactly ``nbytes`` under ONE total wall budget
+        (``SORT_SERVE_READ_TIMEOUT_S``).  On a socket the loop uses
+        ``read1`` — AT MOST ONE underlying ``recv`` per call — with
+        the timeout re-armed to the remaining budget before each, so
+        the deadline is re-checked per recv: neither a dead stall nor
+        a slow drip (whose every tiny chunk "makes progress" and so
+        never trips a per-recv timeout) can hold the thread past the
+        budget (ISSUE 11).  Returns ``(data, outcome)`` with outcome
+        ``"ok"``, ``"short"`` (EOF / reset) or ``"timeout"``;
+        ``keep=False`` drops the bytes (the discard path) instead of
+        accumulating them.  ``conn`` None (in-process callers reading
+        from a BytesIO) reads unbounded — there is no socket to
+        stall."""
+        chunks: list[bytes] = []
+        got = 0
+        deadline = (time.monotonic() + self.read_timeout_s
+                    if conn is not None else None)
+        read1 = getattr(rfile, "read1", None)
+        while got < nbytes:
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return b"".join(chunks), "timeout"
+                try:
+                    conn.settimeout(remaining)
+                except OSError:
+                    return b"".join(chunks), "short"
+            want = min(nbytes - got, 1 << 20)
+            try:
+                # read1 never blocks across multiple recvs; a plain
+                # buffered read(N) would recv in a loop internally,
+                # giving EVERY recv the full remaining budget and
+                # stretching the total far past the deadline
+                chunk = (read1(want) if read1 is not None
+                         else rfile.read(want))
+            except TimeoutError:
+                return b"".join(chunks), "timeout"
+            except OSError:
+                return b"".join(chunks), "short"
+            if not chunk:
+                return b"".join(chunks), "short"
+            if keep:
+                chunks.append(chunk)
+            got += len(chunk)
+        return b"".join(chunks), "ok"
+
+    def _read_header_line(self, rfile: BinaryIO,
+                          conn: "socket.socket",
+                          ) -> tuple[bytes, str]:
+        """Read one header line under two TOTAL budgets: the idle
+        timeout bounds the wait for the FIRST byte (a keep-alive
+        connection sitting between requests), the read timeout bounds
+        the rest of the line (a header dripped byte-by-byte must not
+        reset the clock per recv — a plain ``readline`` would).  Uses
+        ``read1(1)``: at most one raw recv per call, and anything the
+        recv buffered past the requested byte stays in the
+        BufferedReader for the payload reads.  Returns ``(line,
+        outcome)`` with outcome ``ok`` | ``idle`` | ``read`` (the
+        timeout kinds) | ``closed`` (EOF / reset / over-long)."""
+        line = bytearray()
+        read1 = rfile.read1
+        deadline = time.monotonic() + self.idle_timeout_s
+        phase = "idle"
+        while len(line) < (1 << 16):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return bytes(line), phase
+            try:
+                conn.settimeout(remaining)
+                b = read1(1)
+            except TimeoutError:
+                return bytes(line), phase
+            except OSError:
+                return bytes(line), "closed"
+            if not b:
+                return bytes(line), "closed"
+            line += b
+            if phase == "idle":
+                # first byte landed: this is now a request read, on
+                # the request-read budget
+                phase = "read"
+                deadline = time.monotonic() + self.read_timeout_s
+            if b == b"\n":
+                return bytes(line), "ok"
+        return bytes(line), "closed"
+
+    def write_wire(self, conn: "socket.socket", blob: bytes) -> str:
+        """Send a response under ONE total wall budget (the read
+        timeout): per-``send`` socket timeouts reset on any progress,
+        so a client reading one byte per interval could otherwise pin
+        the handler thread for hours on a large payload.  Returns
+        ``"ok"``, ``"timeout"`` or ``"closed"``."""
+        view = memoryview(blob)
+        off = 0
+        deadline = time.monotonic() + self.read_timeout_s
+        while off < len(view):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                self.wire_timeout("write")
+                return "timeout"
+            try:
+                conn.settimeout(remaining)
+                off += conn.send(view[off:off + (1 << 20)])
+            except TimeoutError:
+                self.wire_timeout("write")
+                return "timeout"
+            except OSError:
+                return "closed"
+        return "ok"
+
+    def _discard(self, rfile: BinaryIO, nbytes: int,
+                 conn: "socket.socket | None" = None) -> bool:
+        """Read and drop ``nbytes`` of payload — keeps the
+        connection's framing after a semantic rejection WITHOUT ever
+        buffering the rejected payload (the admission byte bound must
+        bound memory, not just dispatch).  Same bounded reader, same
+        total time budget.  Returns False on a short read or timeout
+        (framing lost)."""
+        _data, outcome = self._read_wire(rfile, nbytes, conn, keep=False)
+        if outcome == "timeout":
+            self.wire_timeout("read")
+        return outcome == "ok"
+
+    def handle_wire(self, header_line: bytes, rfile: BinaryIO,
+                    conn: "socket.socket | None" = None,
+                    ) -> tuple[dict, bytes, bool]:
         """One request from the wire: parse the header, ADMIT (the
         payload only enters host memory after the admission byte/count
         bounds said yes), read the payload, execute, build the
         response.  Returns ``(response header, response payload,
         keep_alive)`` — ``keep_alive`` False means framing is lost
-        (unreadable header / short payload) and the connection must
-        close."""
+        (unreadable header / short payload / read timeout) and the
+        connection must close.  ``conn`` (the TCP layer passes its
+        socket) arms the total read budget; in-process callers reading
+        from a BytesIO pass None and read unbounded."""
         tid: str | None = None   # echoed in every response once known
 
         def err(code: str, detail: str, keep: bool = True,
@@ -432,17 +652,34 @@ class ServerCore:
                        f"bad n={n!r} (integer in [1, {MAX_REQUEST_KEYS}])",
                        keep=False)
         nbytes = n * dtype.itemsize
+        # deadline_ms (ISSUE 11): the client's remaining latency budget
+        # becomes an ABSOLUTE monotonic deadline right here, carried
+        # through admission -> queue -> dispatch; expired work is
+        # cancelled typed, never dispatched.
+        deadline_ms = hdr.get("deadline_ms")
+        deadline: float | None = None
+        if deadline_ms is not None:
+            ok_num = (isinstance(deadline_ms, (int, float))
+                      and not isinstance(deadline_ms, bool)
+                      and math.isfinite(float(deadline_ms))
+                      and float(deadline_ms) > 0)
+            if not ok_num:
+                keep = self._discard(rfile, nbytes, conn)
+                return err(ERR_BAD_REQUEST,
+                           f"bad deadline_ms {deadline_ms!r} (a finite "
+                           "number of milliseconds > 0)", keep=keep)
+            deadline = time.monotonic() + float(deadline_ms) / 1e3
         algo = hdr.get("algo")
         if algo is not None and algo not in ("radix", "sample"):
             # payload not read yet: framing is recoverable only by
             # draining it (bounded chunks) before responding
-            keep = self._discard(rfile, nbytes)
+            keep = self._discard(rfile, nbytes, conn)
             return err(ERR_BAD_REQUEST,
                        f"bad algo {algo!r} (radix | sample)", keep=keep)
         faults_spec = hdr.get("faults")
         if faults_spec is not None:
             if not self.allow_faults:
-                keep = self._discard(rfile, nbytes)
+                keep = self._discard(rfile, nbytes, conn)
                 return err(ERR_BAD_REQUEST,
                            "per-request fault injection requires "
                            "SORT_SERVE_ALLOW_FAULTS=1 on the server",
@@ -450,7 +687,7 @@ class ServerCore:
             try:
                 faults.FaultRegistry(str(faults_spec))
             except ValueError as e:
-                keep = self._discard(rfile, nbytes)
+                keep = self._discard(rfile, nbytes, conn)
                 return err(ERR_BAD_REQUEST, str(e), keep=keep)
         # Admission BEFORE the payload is materialized: a rejected
         # request is drained in bounded chunks, so the in-flight byte
@@ -458,20 +695,28 @@ class ServerCore:
         t0 = time.perf_counter()
         attrs: dict = {"n": n, "dtype": dtype.name, "trace_id": tid}
         try:
-            self.admission.admit(nbytes)
+            self._admit(nbytes)
         except AdmissionReject as e:
             attrs["reject"] = e.reason
             code, detail, _ = self._finish(t0, attrs,
                                            self.reject_code(e), str(e))
-            keep = self._discard(rfile, nbytes)
+            keep = self._discard(rfile, nbytes, conn)
             return err(code, str(detail), keep=keep)
         try:
-            payload = rfile.read(nbytes)
-            if len(payload) != nbytes:
+            # the TOTAL read budget (SORT_SERVE_READ_TIMEOUT_S) starts
+            # here: a client that stalls mid-payload — or drips one
+            # byte per second — is disconnected at the budget, and the
+            # finally below provably reclaims its admission bytes on
+            # THIS exit path like every other (ISSUE 11 satellite).
+            payload, outcome = self._read_wire(rfile, nbytes, conn)
+            if outcome != "ok":
+                if outcome == "timeout":
+                    self.wire_timeout("read")
+                detail = (f"payload read "
+                          f"{'timed out' if outcome == 'timeout' else 'short'}"
+                          f" ({len(payload)}/{nbytes} bytes)")
                 # post-admission outcome like any other: it must land
                 # in the serve.request span stream / error tally too
-                detail = (f"short payload ({len(payload)}/{nbytes} "
-                          "bytes)")
                 self._finish(t0, attrs, ERR_BAD_REQUEST, detail)
                 return err(ERR_BAD_REQUEST, detail, keep=False)
             arr = np.frombuffer(payload, dtype=dtype).copy()
@@ -479,7 +724,7 @@ class ServerCore:
             status, result, attrs = self._dispatch_admitted(
                 t0, attrs, arr, algo,
                 str(faults_spec) if faults_spec is not None else None,
-                tid)
+                tid, deadline)
         finally:
             self.admission.release(nbytes)
         if status != "ok":
@@ -499,28 +744,38 @@ class ServerCore:
 
     def drain_and_stop(self, timeout: float = 60.0) -> bool:
         """SIGTERM semantics: reject new work (typed ``draining``), let
-        in-flight requests complete, stop the dispatch thread.  Returns
-        True when everything drained inside ``timeout``."""
+        in-flight requests complete, stop the dispatch thread and the
+        watchdog.  Returns True ONLY when everything drained AND the
+        dispatch thread actually exited inside ``timeout`` — a wedged
+        dispatch is a dirty exit, not a quiet one (ISSUE 11: the
+        join() outcome used to be silently discarded here)."""
         self.start_drain()
         idle = self.admission.wait_idle(timeout)
-        self.batcher.stop(timeout=10.0)
-        return idle
+        stopped = self.batcher.stop(timeout=10.0)
+        self.watchdog.stop()
+        return idle and stopped
 
 
 class _Handler(socketserver.StreamRequestHandler):
     def handle(self) -> None:
         core: ServerCore = self.server.core  # type: ignore[attr-defined]
         while True:
-            line = self.rfile.readline(1 << 16)
-            if not line or not line.strip():
+            # idle bound for the wait, read bound for the line itself
+            # (ISSUE 11): both TOTAL budgets, not per-recv timeouts
+            line, outcome = core._read_header_line(self.rfile,
+                                                   self.connection)
+            if outcome in ("idle", "read"):
+                core.wire_timeout(outcome)
                 return
-            resp, payload, keep = core.handle_wire(line, self.rfile)
-            try:
-                self.wfile.write(json.dumps(resp).encode("utf-8") + b"\n")
-                if payload:
-                    self.wfile.write(payload)
-                self.wfile.flush()
-            except (BrokenPipeError, ConnectionResetError):
+            if outcome != "ok" or not line.strip():
+                return
+            resp, payload, keep = core.handle_wire(line, self.rfile,
+                                                   self.connection)
+            # response writes share the wire budget: a client that
+            # stops (or trickles) reading cannot pin this thread on a
+            # full send buffer
+            blob = json.dumps(resp).encode("utf-8") + b"\n" + payload
+            if core.write_wire(self.connection, blob) != "ok":
                 return
             if not keep:
                 return

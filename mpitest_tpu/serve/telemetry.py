@@ -180,13 +180,22 @@ class _Handler(BaseHTTPRequestHandler):
     def _healthz(self) -> None:
         core = self.server.core
         draining = core.admission.draining
-        self._json(503 if draining else 200, {
-            "ok": not draining,
+        breaker = core.breaker.state
+        # 503 while draining OR while the breaker is open/half-open
+        # (ISSUE 11): load balancers must stop routing to a server
+        # whose dispatch is wedged, not just one that is shutting down.
+        healthy = not draining and breaker == "closed"
+        self._json(200 if healthy else 503, {
+            "ok": healthy,
             "draining": draining,
+            "breaker": breaker,
             "uptime_s": round(time.time() - core.started, 3),
             "inflight": core.admission.inflight,
             "requests_ok": core.requests_ok,
             "requests_err": core.requests_err,
+            # live thread census: the chaos gate asserts handler
+            # threads are reclaimed after every wire-fault cell
+            "threads": threading.active_count(),
             "pid": os.getpid(),
         })
 
@@ -204,8 +213,11 @@ class _Handler(BaseHTTPRequestHandler):
             "batcher": {"batches": core.batcher.batches,
                         "batched_requests": core.batcher.batched_requests,
                         "solo_requests": core.batcher.solo_requests,
+                        "deadline_cancelled":
+                            core.batcher.deadline_cancelled,
                         "window_s": core.batcher.window_s,
                         "batch_keys": core.batcher.batch_keys},
+            "watchdog": core.watchdog.snapshot(),
             "flight_recorder": {"capacity": rec.capacity,
                                 "recorded": rec.recorded,
                                 "dumps": rec.dumps,

@@ -167,16 +167,30 @@ def _int(name: str, lo: int | None = None, hi: int | None = None,
     return parse
 
 
-def _float_ge0(name: str) -> Callable[[str], float]:
+def _float(name: str, lo: float, exclusive: bool = False,
+           note: str | None = None) -> Callable[[str], float]:
+    """Finite-float parser with one bound — the ONE rule for every
+    float knob (a finite requirement everywhere: 'inf' backoffs and
+    'nan' timeouts are garbage, not policy)."""
     def parse(raw: str) -> float:
+        v: float | None
         try:
             v = float(raw)
         except ValueError:
-            v = -1.0
-        if not v >= 0.0:
-            raise KnobError(f"{name}={raw!r}: use a number >= 0")
-        return v
+            v = None
+        ok = (v is not None and math.isfinite(v)
+              and (v > lo if exclusive else v >= lo))
+        if not ok:
+            op = ">" if exclusive else ">="
+            extra = f" ({note})" if note else ""
+            raise KnobError(f"{name}={raw!r}: use a finite number "
+                            f"{op} {lo:g}{extra}")
+        return v  # type: ignore[return-value]
     return parse
+
+
+def _float_ge0(name: str) -> Callable[[str], float]:
+    return _float(name, 0.0)
 
 
 def _flag(name: str) -> Callable[[str], bool]:
@@ -477,6 +491,10 @@ def _parse_faults_seed(raw: str) -> int:
 register("SORT_FAULTS_SEED", "int", 0, "an integer",
          "Seed of the splitmix64 stream fault corruption values draw from.",
          _parse_faults_seed)
+register("SORT_FAULT_STALL_MS", "int", 250, "an integer >= 1",
+         "Milliseconds the dispatch_stall fault site blocks the "
+         "dispatch thread (the chaos drill behind the watchdog gate).",
+         _int("SORT_FAULT_STALL_MS", lo=1))
 
 # Sort-as-a-service knobs (ISSUE 8: mpitest_tpu/serve/ + the
 # drivers/sort_server.py entry point).  All validated fail-fast at
@@ -571,6 +589,46 @@ register("SORT_SERVE_ALLOW_FAULTS", "flag", False, "1 | 0",
          "Honor per-request fault-injection specs (test mode only; "
          "production servers reject them as bad requests).",
          _flag("SORT_SERVE_ALLOW_FAULTS"))
+
+# Request-lifecycle robustness knobs (ISSUE 11): every wire interaction
+# and every dispatch is time-bounded, so a hostile network or a wedged
+# device costs one bounded thread — never a pinned byte budget or a
+# silently dead server.
+
+
+def _float_gt0(name: str) -> Callable[[str], float]:
+    return _float(name, 0.0, exclusive=True)
+
+
+register("SORT_SERVE_IDLE_TIMEOUT_S", "float", 300.0, "a finite number > 0",
+         "Per-connection idle bound: seconds a keep-alive connection may "
+         "sit between requests before the server closes it.",
+         _float_gt0("SORT_SERVE_IDLE_TIMEOUT_S"))
+register("SORT_SERVE_READ_TIMEOUT_S", "float", 30.0, "a finite number > 0",
+         "Total wire-read budget per request (header payload reads, "
+         "rejected-payload drains, response writes): a client stalled "
+         "mid-payload is disconnected and its admission bytes reclaimed "
+         "within this bound.",
+         _float_gt0("SORT_SERVE_READ_TIMEOUT_S"))
+
+
+register("SORT_SERVE_DISPATCH_TIMEOUT_S", "float", 120.0,
+         "a finite number >= 0 (0 = watchdog off)",
+         "Dispatch watchdog bound: a single dispatch exceeding this "
+         "trips the circuit breaker (healthz 503, fast typed "
+         "rejections) and dumps the flight recorder.",
+         _float("SORT_SERVE_DISPATCH_TIMEOUT_S", 0.0,
+                note="0 disables the watchdog"))
+register("SORT_SERVE_BREAKER_BACKOFF_S", "float", 5.0,
+         "a finite number > 0",
+         "Seconds the tripped circuit breaker stays open before "
+         "half-opening with a probe request (doubles per failed probe).",
+         _float_gt0("SORT_SERVE_BREAKER_BACKOFF_S"))
+register("SORT_SERVE_COMPLETION_TIMEOUT_S", "float", 600.0,
+         "a finite number > 0",
+         "Backstop bound a handler thread waits for its dispatched "
+         "request to complete before failing it typed 'internal'.",
+         _float_gt0("SORT_SERVE_COMPLETION_TIMEOUT_S"))
 
 # Bench-driver knobs (bench.py).
 

@@ -72,6 +72,22 @@ METRICS: dict[str, tuple[str, str]] = {
                    "solo)."),
     "sort_serve_segment_requeues_total": (
         "counter", "Segments that failed verification and re-ran solo."),
+    # request-lifecycle robustness (ISSUE 11)
+    "sort_serve_timeouts_total": (
+        "counter", "Wire timeouts enforced (label: kind=idle|read|"
+                   "write) — stalled/half-dead connections closed."),
+    "sort_serve_deadline_exceeded_total": (
+        "counter", "Requests cancelled before dispatch because their "
+                   "deadline_ms expired (label: stage)."),
+    "sort_serve_watchdog_trips_total": (
+        "counter", "Dispatch-watchdog trips (a dispatch exceeded "
+                   "SORT_SERVE_DISPATCH_TIMEOUT_S; breaker opened)."),
+    "sort_serve_drain_timeout_total": (
+        "counter", "SIGTERM drains that timed out with work still in "
+                   "flight (the server exited rc=1)."),
+    "sort_client_hedges_total": (
+        "counter", "Client-side hedged requests (second attempt fired "
+                   "after the latency threshold)."),
     # executor cache
     "sort_serve_cache_hits_total": (
         "counter", "Executor-cache hits."),
@@ -435,6 +451,19 @@ class SpanMetricsBridge:
                     float(attrs.get("compile_s", 0.0) or 0.0))
         elif name == "serve.profile":
             metrics.counter("sort_profile_captures_total").inc(1)
+        elif name == "serve.deadline":
+            metrics.counter("sort_serve_deadline_exceeded_total").inc(
+                1, stage=str(attrs.get("stage", "?")))
+        elif name == "serve.watchdog":
+            event = str(attrs.get("event", "?"))
+            if event == "trip":
+                metrics.counter("sort_serve_watchdog_trips_total").inc(1)
+            elif event == "drain_timeout":
+                metrics.counter("sort_serve_drain_timeout_total").inc(1)
+        # serve.hedge is deliberately NOT bridged: the ResilientClient
+        # increments sort_client_hedges_total directly at hedge-launch
+        # (semantics: hedges FIRED), and a client wired with both a
+        # bridged spanlog and a metrics registry must not double-count.
         elif name == "verify":
             metrics.counter("sort_verify_runs_total").inc(1)
             if not attrs.get("ok", True):

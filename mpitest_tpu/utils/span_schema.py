@@ -77,6 +77,16 @@ SPAN_NAMES: dict[str, str] = {
     "serve.profile": ("one on-demand jax.profiler capture (logdir, "
                       "trigger=endpoint|every, seq) — ISSUE 10 device "
                       "profiling hook"),
+    # request-lifecycle robustness vocabulary (ISSUE 11)
+    "serve.deadline": ("one request cancelled because its deadline_ms "
+                       "expired before dispatch (stage=admission|queue|"
+                       "dispatch, trace_id) — never dispatched"),
+    "serve.watchdog": ("dispatch-watchdog state change (event=trip|"
+                       "probe|recovered|reopen|drain_timeout; stuck "
+                       "trace_ids, age_s) — the circuit-breaker audit "
+                       "trail"),
+    "serve.hedge": ("one client-side hedged request (winner=primary|"
+                    "hedge, waited_ms) — the p99-tail second attempt"),
     # models/ingest.py — streamed pipeline stages (ISSUE 2)
     "ingest.parse": "parse/materialize one host chunk",
     "ingest.encode": "codec-encode one chunk (worker pool)",
@@ -105,6 +115,12 @@ SERVE_REQUEST_SPAN = "serve.request"
 SERVE_BATCH_SPAN = "serve.batch"
 SERVE_CACHE_SPAN = "serve.compile_cache"
 SERVE_PROFILE_SPAN = "serve.profile"
+
+#: Request-lifecycle robustness names (ISSUE 11): deadline expiries,
+#: watchdog/breaker transitions, client-side hedges.
+SERVE_DEADLINE_SPAN = "serve.deadline"
+SERVE_WATCHDOG_SPAN = "serve.watchdog"
+SERVE_HEDGE_SPAN = "serve.hedge"
 
 #: Request-trace attributes (ISSUE 10): the wire layer mints one
 #: ``trace_id`` per request (echoed in the response) and the dispatch

@@ -1,0 +1,676 @@
+"""Request-lifecycle robustness tests (ISSUE 11) — named to sort last
+like the other zz suites (tier-1 is timeout-bound).
+
+Covers: deadline parsing/propagation/expiry at each lifecycle stage
+(admission, queue, dispatch), the dispatch watchdog's trip → half-open
+→ recover machine (stub-level AND against a real wedged dispatch via
+the ``dispatch_stall`` fault site), client retry/backoff and hedging
+against an in-process flaky server, the wire-fault spec round trip and
+the chaos proxy, wire read timeouts + the admission-byte-release
+regression (client killed mid-payload), and the new knob contracts.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import threading
+import time
+from contextlib import contextmanager
+
+import numpy as np
+import pytest
+
+from mpitest_tpu import faults
+from mpitest_tpu.serve.batching import ERR_DEADLINE, Batcher, ServeRequest
+from mpitest_tpu.serve.client import (ResilientClient, ServeClient,
+                                      ServeReply, reply_fingerprint_ok)
+from mpitest_tpu.serve.watchdog import CircuitBreaker
+from mpitest_tpu.utils import flight_recorder, knobs
+from mpitest_tpu.utils.spans import SpanLog
+
+
+@contextmanager
+def serve_core(**env):
+    """A ServerCore configured via scoped knobs; dispatch thread (and
+    watchdog, if started) stopped at exit."""
+    from mpitest_tpu.serve.server import ServerCore
+
+    with knobs.scoped_env(**env):
+        core = ServerCore()
+        try:
+            yield core
+        finally:
+            core.watchdog.stop()
+            core.batcher.stop(timeout=10)
+
+
+@contextmanager
+def wire_server(core):
+    """An in-process TCP front over ``core`` (real sockets, real
+    handler threads — the layer the wire timeouts live in)."""
+    from mpitest_tpu.serve.server import SortServer
+
+    srv = SortServer(core, "127.0.0.1", 0)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        yield srv.bound_port
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def _req(arr, **kw):
+    defaults = dict(arr=arr, dtype=np.dtype(arr.dtype), algo="sample",
+                    batchable=True, trace_id="t")
+    defaults.update(kw)
+    return ServeRequest(**defaults)
+
+
+def wait_until(pred, timeout_s=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+# ------------------------------------------------------ wire-fault spec
+
+def test_wire_fault_spec_round_trip():
+    fs = faults.parse_wire_faults(
+        "wire_torn_header@3, wire_delay_response@200:4,"
+        "wire_connect_silence")
+    assert [f.site for f in fs] == ["wire_torn_header",
+                                    "wire_delay_response",
+                                    "wire_connect_silence"]
+    assert fs[1].param == 200 and fs[1].every == 4
+    # canonical spec round-trips through the parser
+    again = faults.parse_wire_faults(",".join(f.spec() for f in fs))
+    assert again == fs
+    # defaults fill in
+    assert faults.parse_wire_faults("wire_stall_payload")[0].param == \
+        faults.WIRE_DEFAULT_PARAM["wire_stall_payload"]
+    # every-cadence: every=4 fires on the 4th, 8th, ... (0-based 3, 7)
+    f = faults.parse_wire_faults("wire_delay_response:4")[0]
+    assert [i for i in range(9) if f.fires_on(i)] == [3, 7]
+
+
+def test_wire_fault_spec_rejects_garbage():
+    with pytest.raises(ValueError, match="unknown site"):
+        faults.parse_wire_faults("wire_nonsense")
+    with pytest.raises(ValueError, match="bad param"):
+        faults.parse_wire_faults("wire_torn_header@x")
+    with pytest.raises(ValueError, match="bad every-count"):
+        faults.parse_wire_faults("wire_torn_header:0")
+    with pytest.raises(ValueError, match="empty spec"):
+        faults.parse_wire_faults(" , ")
+
+
+def test_dispatch_stall_site_registered():
+    # the watchdog drill site rides the ordinary registry/grid
+    assert "dispatch_stall" in faults.SITES
+    reg = faults.FaultRegistry("dispatch_stall")
+    assert reg.would_fire("dispatch_stall")
+
+
+# ------------------------------------------------------------ deadlines
+
+def test_serve_request_deadline_helpers():
+    a = np.arange(4, dtype=np.int32)
+    r = _req(a)
+    assert not r.expired()
+    r = _req(a, deadline=time.monotonic() - 0.01)
+    assert r.expired()
+    r.fail_deadline("queue")
+    assert r.done.is_set()
+    assert r.error[0] == ERR_DEADLINE == "deadline_exceeded"
+    assert r.deadline_stage == "queue"
+
+
+def test_deadline_expiry_at_admission_stage(rng):
+    with serve_core(SORT_SERVE_BATCH_WINDOW_MS="0") as core:
+        a = rng.integers(-2**31, 2**31 - 1, size=256, dtype=np.int32)
+        st, detail, attrs = core.execute(a, deadline_ms=1e-4)
+        assert st == "deadline_exceeded"
+        assert attrs["deadline_stage"] == "admission"
+        # admission bytes provably released
+        assert core.admission.inflight_bytes == 0
+        assert core.admission.inflight == 0
+        # the registered audit event fired with the stage
+        ev = [s for s in core.tracer.spans.spans
+              if s.name == "serve.deadline"]
+        assert ev and ev[-1].attrs["stage"] == "admission"
+        # an un-deadlined request still flows
+        st2, out, _ = core.execute(a)
+        assert st2 == "ok" and np.array_equal(out, np.sort(a))
+
+
+def test_deadline_expiry_in_queue_and_window_close():
+    """Stub-executor batcher: a request whose deadline dies while a
+    slow dispatch holds the thread is cancelled at pickup (stage
+    queue, never handed to an executor), and the batch window closes
+    at the earliest member deadline instead of the full window."""
+    dispatched: list[str] = []
+
+    def run_batch(reqs):
+        dispatched.extend(r.trace_id for r in reqs)
+        time.sleep(0.3)          # the slow dispatch the victim queues behind
+        for r in reqs:
+            r.complete(r.arr, batched=True, bucket=None)
+
+    def run_solo(req):
+        dispatched.append(req.trace_id)
+        req.complete(req.arr, batched=False, bucket=None)
+
+    a = np.arange(8, dtype=np.int32)
+    b = Batcher(run_batch, run_solo, window_s=0.0, batch_keys=1 << 16)
+    try:
+        first = _req(a, trace_id="slow")
+        b.submit(first)
+        victim = _req(a, trace_id="victim",
+                      deadline=time.monotonic() + 0.05)
+        b.submit(victim)
+        assert victim.done.wait(5.0)
+        assert victim.error[0] == ERR_DEADLINE
+        assert victim.deadline_stage == "queue"
+        assert first.done.wait(5.0) and first.error is None
+        assert "victim" not in dispatched       # never dispatched
+        assert b.deadline_cancelled == 1
+    finally:
+        b.stop(timeout=5)
+
+    # earliest-member deadline closes the pack window early
+    t_dispatch: list[float] = []
+
+    def run_batch2(reqs):
+        t_dispatch.append(time.monotonic())
+        for r in reqs:
+            r.complete(r.arr, batched=True, bucket=None)
+
+    b2 = Batcher(run_batch2, run_solo, window_s=10.0, batch_keys=1 << 16)
+    try:
+        t0 = time.monotonic()
+        hurried = _req(a, trace_id="hurried",
+                       deadline=time.monotonic() + 0.15)
+        b2.submit(hurried)
+        assert hurried.done.wait(5.0)
+        assert hurried.error is None            # dispatched, not expired
+        assert t_dispatch and t_dispatch[0] - t0 < 5.0, \
+            "window ignored the member deadline"
+        assert t_dispatch[0] - t0 < 1.0
+    finally:
+        b2.stop(timeout=5)
+
+
+def test_deadline_wire_parse_and_propagation(rng, mesh8):
+    import io
+
+    with serve_core(SORT_SERVE_BATCH_WINDOW_MS="0") as core:
+        a = rng.integers(-2**31, 2**31 - 1, size=128, dtype=np.int32)
+
+        def wire(hdr_extra, payload=None):
+            hdr = {"v": "sortserve.v1", "dtype": "int32",
+                   "n": int(a.size), **hdr_extra}
+            body = a.tobytes() if payload is None else payload
+            return core.handle_wire(
+                json.dumps(hdr).encode() + b"\n", io.BytesIO(body))
+
+        # garbage deadline_ms is a typed wire error, framing kept
+        for bad in ("soon", -5, 0, float("nan"), True):
+            resp, _p, keep = wire({"deadline_ms": bad})
+            assert not resp["ok"] and resp["error"] == "bad_request"
+            assert keep is True, bad
+        # generous deadline: served normally
+        resp, payload, keep = wire({"deadline_ms": 60000})
+        assert resp["ok"] and keep
+        assert np.array_equal(np.frombuffer(payload, np.int32),
+                              np.sort(a))
+        # microscopic deadline: typed deadline_exceeded, bytes released
+        resp, payload, keep = wire({"deadline_ms": 1e-4})
+        assert not resp["ok"]
+        assert resp["error"] == "deadline_exceeded"
+        assert payload == b"" and keep
+        assert core.admission.inflight_bytes == 0
+
+
+def test_executor_entry_deadline_gate(rng):
+    """Stage 'dispatch': a request that expires between queue pickup
+    and executor entry is cancelled inside the executor wrapper."""
+    with serve_core(SORT_SERVE_BATCH_WINDOW_MS="0") as core:
+        a = rng.integers(-2**31, 2**31 - 1, size=64, dtype=np.int32)
+        req = _req(a, batchable=False,
+                   deadline=time.monotonic() - 0.01)
+        core._run_solo(req)
+        assert req.error[0] == ERR_DEADLINE
+        assert req.deadline_stage == "dispatch"
+
+
+# ------------------------------------------------- watchdog + breaker
+
+def test_circuit_breaker_state_machine():
+    br = CircuitBreaker(backoff_s=0.05)
+    assert br.state == "closed" and not br.engaged()
+    assert br.trip() is True
+    assert br.trip() is False            # already open: one incident
+    assert br.engaged() and br.state == "open"
+    assert not br.ready_to_probe()       # backoff not elapsed
+    time.sleep(0.06)
+    assert br.ready_to_probe()
+    assert br.state == "half_open" and br.engaged()
+    br.probe_failed()                    # backoff doubles, reopens
+    assert br.state == "open"
+    assert br.snapshot()["backoff_s"] == pytest.approx(0.1)
+    time.sleep(0.11)
+    assert br.ready_to_probe()
+    br.probe_succeeded()
+    assert br.state == "closed" and not br.engaged()
+    assert br.trips == 1 and br.recoveries == 1
+
+
+def test_watchdog_trips_on_wedged_dispatch_and_recovers(
+        rng, mesh8, tmp_path):
+    """The real thing end to end: a per-request ``dispatch_stall``
+    wedges the REAL dispatch thread (distributed path, supervisor
+    dispatch); the watchdog must trip the breaker (typed fast
+    rejections, flight-recorder artifact), fail queued work typed, and
+    the half-open probe must recover WITHOUT a restart."""
+    flight_recorder.reset()
+    try:
+        with serve_core(SORT_SERVE_ALLOW_FAULTS="1",
+                        SORT_FAULT_STALL_MS="1500",
+                        SORT_SERVE_DISPATCH_TIMEOUT_S="0.3",
+                        SORT_SERVE_BREAKER_BACKOFF_S="0.2",
+                        SORT_SERVE_BATCH_WINDOW_MS="0",
+                        SORT_FLIGHT_RECORDER_DIR=str(tmp_path),
+                        ) as core:
+            core.start_watchdog()
+            a = rng.integers(-2**31, 2**31 - 1, size=2048,
+                             dtype=np.int32)
+            st, out, _ = core.execute(a)          # warm the programs
+            assert st == "ok"
+            res: dict = {}
+
+            def stalled():
+                res["r"] = core.execute(a, faults_spec="dispatch_stall")
+
+            t = threading.Thread(target=stalled, daemon=True)
+            t.start()
+            assert wait_until(lambda: core.breaker.state != "closed",
+                              5.0), "watchdog never tripped"
+            # while engaged: admission is a FAST typed rejection
+            st2, detail, attrs = core.execute(a)
+            assert st2 == "backpressure"
+            assert attrs["reject"] == "breaker"
+            # the wedge clears (~1.5s); the probe must close the breaker
+            assert wait_until(lambda: core.breaker.state == "closed",
+                              15.0), "breaker never recovered"
+            t.join(timeout=30)
+            assert res["r"][0] == "ok"    # the stalled sort completed
+            st3, out3, _ = core.execute(a)
+            assert st3 == "ok" and np.array_equal(out3, np.sort(a))
+            # audit trail: trip + recovered events, counted trips
+            events = [s.attrs.get("event") for s in
+                      core.tracer.spans.spans
+                      if s.name == "serve.watchdog"]
+            assert "trip" in events and "recovered" in events
+            assert core.breaker.trips == 1
+            assert core.metrics.counter(
+                "sort_serve_watchdog_trips_total").get() == 1
+            # the incident artifact exists and is schema-clean
+            arts = sorted(tmp_path.glob("flight-*-watchdog-*.jsonl"))
+            assert arts, "watchdog trip wrote no flight artifact"
+            from mpitest_tpu.report import check_rows, load_rows
+
+            assert check_rows(load_rows(str(arts[-1]))) == []
+    finally:
+        flight_recorder.reset()
+
+
+def test_watchdog_fails_queued_requests_typed():
+    """While the dispatch thread is wedged, queued work is failed
+    typed 'internal' by the trip — nobody burns the completion
+    timeout on a corpse (stub executors, no jax)."""
+    import types
+
+    release = threading.Event()
+
+    def run_solo(req):
+        release.wait(10.0)
+        req.complete(req.arr, batched=False, bucket=None)
+
+    def run_batch(reqs):
+        for r in reqs:
+            run_solo(r)
+
+    b = Batcher(run_batch, run_solo, window_s=0.0, batch_keys=1 << 16)
+    from mpitest_tpu.serve.watchdog import DispatchWatchdog
+    from mpitest_tpu.utils.trace import Tracer
+
+    core = types.SimpleNamespace(batcher=b, tracer=Tracer(),
+                                 default_algo="sample")
+    br = CircuitBreaker(backoff_s=30.0)   # no probe during the test
+    wd = DispatchWatchdog(core, timeout_s=0.2, breaker=br)
+    try:
+        a = np.arange(8, dtype=np.int32)
+        wedged = _req(a, trace_id="wedged", batchable=False)
+        queued = _req(a, trace_id="queued", batchable=False)
+        b.submit(wedged)
+        b.submit(queued)
+        wd.start()
+        assert queued.done.wait(5.0), "queued request never failed"
+        assert queued.error[0] == "internal"
+        assert "watchdog" in queued.error[1]
+        assert br.state == "open" and br.trips == 1
+        release.set()
+        assert wedged.done.wait(5.0) and wedged.error is None
+    finally:
+        release.set()
+        wd.stop()
+        b.stop(timeout=5)
+
+
+def test_batcher_stop_reports_wedged_thread():
+    """The drain-path regression (ISSUE 11 satellite): stop() must
+    return False while a dispatch is wedged — the silently-discarded
+    join() outcome that let drain_and_stop report a clean exit."""
+    release = threading.Event()
+
+    def run_solo(req):
+        release.wait(10.0)
+        req.complete(req.arr, batched=False, bucket=None)
+
+    b = Batcher(lambda reqs: None, run_solo, window_s=0.0,
+                batch_keys=1 << 16)
+    try:
+        b.submit(_req(np.arange(4, dtype=np.int32), batchable=False))
+        time.sleep(0.1)
+        assert b.stop(timeout=0.2) is False
+        release.set()
+        assert b.stop(timeout=5.0) is True
+    finally:
+        release.set()
+
+
+# ------------------------------------------------------- wire timeouts
+
+def test_stalled_mid_payload_disconnected_and_bytes_released(rng):
+    """THE regression (ISSUE 11 satellite): a client that stalls (or
+    dies) mid-payload used to pin a handler thread and its admitted
+    byte budget until process death.  Now: disconnected within the
+    read timeout, ``sort_serve_inflight_bytes`` back to 0."""
+    with serve_core(SORT_SERVE_READ_TIMEOUT_S="0.5",
+                    SORT_SERVE_BATCH_WINDOW_MS="0") as core:
+        with wire_server(core) as port:
+            a = rng.integers(-2**31, 2**31 - 1, size=1 << 14,
+                             dtype=np.int32)
+            hdr = json.dumps({"v": "sortserve.v1", "dtype": "int32",
+                              "n": int(a.size)}).encode() + b"\n"
+            # variant 1: stall silently mid-payload, connection open
+            s = socket.create_connection(("127.0.0.1", port), timeout=10)
+            s.sendall(hdr + a.tobytes()[: a.nbytes // 2])
+            assert wait_until(lambda: core.admission.inflight_bytes > 0,
+                              5.0), "request never admitted"
+            t0 = time.monotonic()
+            assert wait_until(
+                lambda: core.admission.inflight_bytes == 0, 5.0), \
+                "admission bytes leaked on a stalled payload"
+            assert time.monotonic() - t0 < 4.0
+            assert core.metrics.counter(
+                "sort_serve_timeouts_total").get(kind="read") >= 1
+            s.close()
+            # variant 2: killed mid-payload (abrupt close)
+            s2 = socket.create_connection(("127.0.0.1", port),
+                                          timeout=10)
+            s2.sendall(hdr + a.tobytes()[: a.nbytes // 2])
+            s2.close()
+            assert wait_until(
+                lambda: core.admission.inflight_bytes == 0, 5.0)
+            # the server still serves
+            x = rng.integers(-2**31, 2**31 - 1, size=600,
+                             dtype=np.int32)
+            with ServeClient("127.0.0.1", port, timeout=30) as c:
+                r = c.sort(x)
+            assert r.ok and np.array_equal(r.arr, np.sort(x))
+
+
+def test_idle_connection_closed(rng):
+    with serve_core(SORT_SERVE_IDLE_TIMEOUT_S="0.3",
+                    SORT_SERVE_BATCH_WINDOW_MS="0") as core:
+        with wire_server(core) as port:
+            s = socket.create_connection(("127.0.0.1", port), timeout=10)
+            s.settimeout(5.0)
+            # say nothing; the server must hang up within the idle bound
+            assert s.recv(1) == b""
+            s.close()
+            assert core.metrics.counter(
+                "sort_serve_timeouts_total").get(kind="idle") >= 1
+
+
+# ----------------------------------------------------- client resilience
+
+class _FlakyHandler(socketserver.StreamRequestHandler):
+    """Protocol-speaking flaky server: behavior by connection index via
+    server.plan — 'die' (close at accept), 'backpressure' (typed
+    rejection), 'stall' (hold the reply), int/float seconds, 'ok'."""
+
+    def handle(self):
+        srv = self.server
+        with srv.lock:
+            idx = srv.conn_seq
+            srv.conn_seq += 1
+        mode = srv.plan[min(idx, len(srv.plan) - 1)]
+        if mode == "die":
+            return
+        while True:
+            line = self.rfile.readline()
+            if not line.strip():
+                return
+            hdr = json.loads(line)
+            n, dt = hdr["n"], np.dtype(hdr["dtype"])
+            arr = np.frombuffer(self.rfile.read(n * dt.itemsize), dt)
+            if mode == "backpressure":
+                self.wfile.write(json.dumps(
+                    {"ok": False, "error": "backpressure",
+                     "detail": "induced",
+                     "trace_id": hdr.get("trace_id")}).encode() + b"\n")
+                self.wfile.flush()
+                continue
+            if isinstance(mode, (int, float)):
+                time.sleep(float(mode))
+            out = np.sort(arr)
+            self.wfile.write(json.dumps(
+                {"ok": True, "n": n, "dtype": dt.name,
+                 "trace_id": hdr.get("trace_id")}).encode() + b"\n"
+                + out.tobytes())
+            self.wfile.flush()
+
+
+@contextmanager
+def flaky_server(plan):
+    srv = socketserver.ThreadingTCPServer(("127.0.0.1", 0),
+                                          _FlakyHandler)
+    srv.daemon_threads = True
+    srv.plan = plan
+    srv.conn_seq = 0
+    srv.lock = threading.Lock()
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        yield srv.server_address[1]
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_client_retries_connect_errors_with_backoff(rng):
+    a = rng.integers(-2**31, 2**31 - 1, size=300, dtype=np.int32)
+    with flaky_server(["die", "die", "ok"]) as port:
+        c = ResilientClient("127.0.0.1", port, backoff_s=0.01,
+                            max_attempts=4)
+        r = c.sort(a)
+        assert r.ok and np.array_equal(r.arr, np.sort(a))
+        assert c.stats["retries"] == 2
+        assert c.stats["transport_errors"] == 2
+
+
+def test_client_retries_typed_retryable_and_respects_budget(rng):
+    a = rng.integers(-2**31, 2**31 - 1, size=300, dtype=np.int32)
+    with flaky_server(["backpressure", "ok"]) as port:
+        c = ResilientClient("127.0.0.1", port, backoff_s=0.01,
+                            max_attempts=3)
+        r = c.sort(a)
+        assert r.ok and c.stats["retries"] == 1
+    # budget exhausted on a persistently-backpressured server: the
+    # typed reply is returned, never an infinite loop
+    with flaky_server(["backpressure"]) as port:
+        c = ResilientClient("127.0.0.1", port, backoff_s=0.01,
+                            max_attempts=2)
+        r = c.sort(a)
+        assert not r.ok and r.error == "backpressure"
+        assert c.stats["retries"] == 1
+    # non-retryable typed errors come straight back
+    with serve_core(SORT_SERVE_BATCH_WINDOW_MS="0") as core:
+        with wire_server(core) as port:
+            c = ResilientClient("127.0.0.1", port, max_attempts=3)
+            r = c.sort(a, algo=None, trace_id="bad id!" )
+            # the server rejects the malformed trace id typed; the
+            # client must NOT burn retries on it
+            assert not r.ok and r.error == "bad_request"
+            assert c.stats["retries"] == 0
+
+
+def test_client_deadline_budget_shrinks_across_retries(rng):
+    """The end-to-end deadline is ONE budget: elapsed backoff and
+    failed attempts shrink what later attempts send, and once spent
+    the client fails locally typed — it never hands the server a
+    fresh full deadline per retry."""
+    a = rng.integers(-2**31, 2**31 - 1, size=64, dtype=np.int32)
+    with flaky_server(["backpressure"]) as port:
+        c = ResilientClient("127.0.0.1", port, backoff_s=0.06,
+                            jitter=0.0, max_attempts=50)
+        t0 = time.monotonic()
+        r = c.sort(a, deadline_ms=150)
+        took = time.monotonic() - t0
+        assert not r.ok and r.error == "deadline_exceeded"
+        assert "client-side" in r.detail
+        assert took < 2.0                       # bounded, not 50 retries
+        assert c.stats["attempts"] < 50
+
+
+def test_slow_drip_bounded_by_total_read_budget(rng):
+    """A drip client whose every chunk 'makes progress' must still be
+    shed at the TOTAL read budget (per-recv timeouts alone would never
+    fire) — the review-found read1 contract."""
+    with serve_core(SORT_SERVE_READ_TIMEOUT_S="0.5",
+                    SORT_SERVE_BATCH_WINDOW_MS="0") as core:
+        with wire_server(core) as port:
+            n = 1 << 14
+            hdr = json.dumps({"v": "sortserve.v1", "dtype": "int32",
+                              "n": n}).encode() + b"\n"
+            s = socket.create_connection(("127.0.0.1", port), timeout=10)
+            s.sendall(hdr)
+            t0 = time.monotonic()
+            shed = False
+            try:
+                # 100 B every 120 ms: each recv succeeds well inside
+                # any per-recv timeout; only the total budget binds
+                for _ in range(40):
+                    s.sendall(b"\x01" * 100)
+                    time.sleep(0.12)
+            except OSError:
+                shed = True
+            assert shed, "server never shed the drip"
+            assert time.monotonic() - t0 < 3.0
+            s.close()
+            assert wait_until(
+                lambda: core.admission.inflight_bytes == 0, 5.0)
+            assert core.metrics.counter(
+                "sort_serve_timeouts_total").get(kind="read") >= 1
+
+
+def test_client_hedging_cuts_injected_tail(rng):
+    """First connection's reply held 1s, second instant: the hedge
+    fires at 0.1s and wins; the reply is fingerprint-verified."""
+    a = rng.integers(-2**31, 2**31 - 1, size=300, dtype=np.int32)
+    spanlog = SpanLog()
+    with flaky_server([1.0, "ok", "ok"]) as port:
+        c = ResilientClient("127.0.0.1", port, hedge_after_s=0.1,
+                            read_timeout=10.0, spanlog=spanlog)
+        t0 = time.perf_counter()
+        r = c.sort(a, trace_id="hedge-unit")
+        dt = time.perf_counter() - t0
+        assert r.ok and np.array_equal(r.arr, np.sort(a))
+        assert dt < 0.8, f"hedge did not cut the tail ({dt:.2f}s)"
+        assert c.stats["hedges"] == 1 and c.stats["hedge_wins"] == 1
+        hedge_spans = [s for s in spanlog.spans if s.name == "serve.hedge"]
+        assert hedge_spans and hedge_spans[0].attrs["winner"] == "hedge"
+
+
+def test_reply_fingerprint_rejects_foreign_bytes(rng):
+    a = rng.integers(-2**31, 2**31 - 1, size=64, dtype=np.int32)
+    good = ServeReply(True, {"ok": True}, np.sort(a))
+    assert reply_fingerprint_ok(a, good)
+    # truncation, reordering-with-substitution, and unsorted replies
+    # all fail at least one of the three checks
+    assert not reply_fingerprint_ok(a, ServeReply(True, {},
+                                                  np.sort(a)[:-1]))
+    substituted = np.sort(a).copy()
+    substituted[0] = substituted[0] ^ 1      # sorted, but foreign bytes
+    assert not reply_fingerprint_ok(a, ServeReply(True, {}, substituted))
+    assert not reply_fingerprint_ok(a, ServeReply(False, {}))  # errors
+    unsorted = np.sort(a)[::-1].copy()       # right multiset, bad order
+    assert not reply_fingerprint_ok(a, ServeReply(True, {}, unsorted))
+
+
+# ----------------------------------------------------------- chaos proxy
+
+def test_chaos_proxy_torn_header_and_delay(rng):
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent
+                           / "bench"))
+    from wire_chaos import ChaosProxy
+
+    a = rng.integers(-2**31, 2**31 - 1, size=200, dtype=np.int32)
+    with flaky_server(["ok"]) as port:
+        with ChaosProxy("127.0.0.1", port, "wire_torn_header@4") as px:
+            with pytest.raises((OSError, ConnectionError)):
+                ServeClient("127.0.0.1", px.port, timeout=5).sort(a)
+            assert px.log[0] == (0, "wire_torn_header")
+        # upstream server is untouched: direct request still works
+        with ServeClient("127.0.0.1", port, timeout=5) as c:
+            assert c.sort(a).ok
+        with ChaosProxy("127.0.0.1", port,
+                        "wire_delay_response@300:2") as px:
+            with ServeClient("127.0.0.1", px.port, timeout=10) as c:
+                t0 = time.perf_counter()
+                assert c.sort(a).ok                 # conn 0: clean
+                fast = time.perf_counter() - t0
+            with ServeClient("127.0.0.1", px.port, timeout=10) as c:
+                t0 = time.perf_counter()
+                assert c.sort(a).ok                 # conn 1: delayed
+                slow = time.perf_counter() - t0
+            assert slow >= 0.28 > fast
+
+
+# -------------------------------------------------------- knob contract
+
+def test_lifecycle_knob_validation():
+    cases = {
+        "SORT_SERVE_IDLE_TIMEOUT_S": "0",
+        "SORT_SERVE_READ_TIMEOUT_S": "-1",
+        "SORT_SERVE_DISPATCH_TIMEOUT_S": "nan",
+        "SORT_SERVE_BREAKER_BACKOFF_S": "x",
+        "SORT_SERVE_COMPLETION_TIMEOUT_S": "0",
+        "SORT_FAULT_STALL_MS": "0",
+    }
+    for name, bad in cases.items():
+        with knobs.scoped_env(**{name: bad}):
+            with pytest.raises(knobs.KnobError, match=name):
+                knobs.get(name)
+    with knobs.scoped_env(SORT_SERVE_DISPATCH_TIMEOUT_S="0"):
+        assert knobs.get("SORT_SERVE_DISPATCH_TIMEOUT_S") == 0.0

@@ -98,8 +98,15 @@ PYTHON ?= python3
 chip-test:
 	$(PYTHON) -u bench/chip_regression.py
 
+# The CI test job (ISSUE 12 satellite): the pytest suite PLUS the
+# bench-trajectory gate — a perf regression in a recorded BENCH_rNN row
+# fails the build instead of only rendering under `make bench-history`.
+# Threshold 0.8 sits just below the known r05 ingest-ratio wobble
+# (0.85x of the r03 best), so the pre-existing trajectory stays green
+# and only NEW regressions fail.
 test: native
 	$(PYTHON) -m pytest tests/ -q
+	$(PYTHON) tools/bench_history.py --strict --threshold 0.8
 
 native:
 	$(MAKE) -C mpi_sample_sort BACKEND=local
@@ -141,6 +148,11 @@ telemetry-selftest:
 	    $(TELEMETRY_TMP)/trace.jsonl $(TELEMETRY_TMP)/comm_stats.jsonl
 	$(PYTHON) -m mpitest_tpu.report \
 	    $(TELEMETRY_TMP)/trace.jsonl $(TELEMETRY_TMP)/comm_stats.jsonl
+	# explain leg (ISSUE 12): the CLI run's decision record renders as
+	# an EXPLAIN tree from the same stream; the live selftest then
+	# asserts the serve-side half (plan spans registered, regret
+	# metrics scraped, negotiate-off > negotiated cap regret)
+	$(PYTHON) -m mpitest_tpu.report --explain $(TELEMETRY_TMP)/trace.jsonl
 	JAX_PLATFORMS=cpu \
 	    $(PYTHON) -u bench/telemetry_live_selftest.py \
 	    --out $(TELEMETRY_TMP)/live

@@ -210,6 +210,14 @@ def _measure_multichip(algo: str, dtype: np.dtype, log2n: int,
             f"{c.get('exchange_balance_ratio')}")
     if c.get("skew_restage"):
         row["restaged"] = int(c["skew_restage"])
+    # Plan digest (ISSUE 12): the decisions behind this row's number,
+    # pinned beside it so `report.py --baseline` can flag DECISION
+    # drift (algo/cap/restage/regret), not just throughput drift.
+    if "plan_regret" in c:
+        row["plan_regret"] = round(float(c["plan_regret"]), 6)
+        metrics.record("plan_regret", row["plan_regret"], "x")
+    if "plan_cap_regret" in c:
+        row["plan_cap_regret"] = round(float(c["plan_cap_regret"]), 6)
     metrics.record_tracer(tracer)
     metrics.dump()
     return row
@@ -710,6 +718,11 @@ def main() -> None:
         out["encode_gb_per_s"] = encode_gbs
     if ingest_ratio is not None:
         out["ingest_ratio"] = ingest_ratio
+    # Plan digest (ISSUE 12): decision provenance pinned in the row so
+    # the trajectory captures what was DECIDED, not only what it scored.
+    if "plan_regret" in tracer.counters:
+        out["plan_regret"] = round(float(tracer.counters["plan_regret"]),
+                                   6)
     if vs_canonical is not None:
         out["vs_canonical_native"] = round(vs_canonical, 3)
     elif canon_skipped:

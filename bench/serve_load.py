@@ -243,6 +243,9 @@ def run_load(port: int, requests: int, concurrency: int, seed: int,
     keys = [0]
     bad_parity = [0]
     counter = [0]
+    #: plan digests echoed in response headers (ISSUE 12): the
+    #: client-visible decision record, folded into the bench row
+    plans: list[dict] = []
 
     def worker(widx: int) -> None:
         rng = np.random.default_rng(seed + widx)
@@ -281,6 +284,8 @@ def run_load(port: int, requests: int, concurrency: int, seed: int,
                     if r.ok:
                         lat.append(dt)
                         keys[0] += n
+                        if r.plan is not None:
+                            plans.append(r.plan)
                         if not np.array_equal(r.arr, np.sort(x)):
                             bad_parity[0] += 1
         finally:
@@ -301,7 +306,29 @@ def run_load(port: int, requests: int, concurrency: int, seed: int,
             "latency_hist": latency_histogram(lat),
             "statuses": statuses, "keys": keys[0],
             "bad_parity": bad_parity[0],
-            "keys_per_s": keys[0] / wall if wall > 0 else 0.0}
+            "keys_per_s": keys[0] / wall if wall > 0 else 0.0,
+            "plan": fold_plans(plans)}
+
+
+def fold_plans(plans: list) -> dict:
+    """Fold response-header plan digests (ISSUE 12) into the summary
+    the bench row pins: digest count, algo census, mean regret and the
+    bucket set — `report.py --baseline` flags drift in these alongside
+    the throughput numbers."""
+    regrets = [float(p["regret"]) for p in plans
+               if isinstance(p.get("regret"), (int, float))]
+    algos: dict = {}
+    for p in plans:
+        a = str(p.get("algo", "?"))
+        algos[a] = algos.get(a, 0) + 1
+    return {
+        "digests": len(plans),
+        "algos": algos,
+        "mean_regret": (round(sum(regrets) / len(regrets), 6)
+                        if regrets else None),
+        "buckets": sorted({int(p["bucket"]) for p in plans
+                           if isinstance(p.get("bucket"), int)}),
+    }
 
 
 def latency_histogram(latencies: list) -> dict:
@@ -427,6 +454,16 @@ def emit_row(stats: dict, extra: dict) -> dict:
         "latency_hist": stats.get("latency_hist"),
         **extra,
     }
+    # plan digest summary (ISSUE 12): the decisions the server made for
+    # this row's traffic, pinned so decision drift is baseline-flaggable
+    p = stats.get("plan") or {}
+    if p.get("digests"):
+        row["plan_digests"] = p["digests"]
+        row["plan_algos"] = p["algos"]
+        if p.get("mean_regret") is not None:
+            row["plan_regret"] = p["mean_regret"]
+        if p.get("buckets"):
+            row["plan_buckets"] = p["buckets"]
     print(json.dumps(row), flush=True)
     return row
 

@@ -7,6 +7,14 @@ spawned server:
    ``trace_id`` is echoed on the wire AND fully reconstructable from
    telemetry alone: ``report.py --trace-id`` shows its queue wait,
    batch membership, dispatch and reply spans (the acceptance demo).
+6. **explain leg (ISSUE 12)** — a traced request's decision record
+   renders as a ``report.py --explain`` tree; ``sort.plan`` spans pass
+   ``--require-registered-spans``; the plan-regret metrics appear in
+   the ``/metrics`` scrape; and the acceptance comparison: the same
+   skewed 2-device input with ``SORT_NEGOTIATE=off`` exports strictly
+   MORE cap regret than the negotiated run (and the negotiated run's
+   explain tree shows the restage decision with predicted peer-need vs
+   measured recv bytes and a finite regret).
 2. **/metrics** — scrapeable while serving; exposition format valid;
    every exported name registered in ``utils/metrics_live.py``;
    request counters reconcile EXACTLY with the client's own accounting.
@@ -48,6 +56,97 @@ def http_get(port: int, path: str) -> bytes:
     with urllib.request.urlopen(f"http://{HOST}:{port}{path}",
                                 timeout=30) as r:
         return r.read()
+
+
+def explain_leg(streamed: list, tids: list, fams: dict,
+                trace_path: Path) -> list:
+    """The ISSUE 12 acceptance checks (see module docstring item 6).
+    ``streamed``: the server's (sampled) span stream as dicts;
+    ``tids``: surviving live-req trace ids; ``fams``: the parsed
+    /metrics scrape; ``trace_path``: the stream on disk (driven
+    through the real ``--explain --trace-id`` CLI)."""
+    import io
+    from contextlib import redirect_stdout
+
+    import numpy as np
+
+    from mpitest_tpu import report as report_mod
+    from mpitest_tpu.utils import knobs
+
+    fails: list[str] = []
+    # 1. plan spans reached the wire stream and render as a tree;
+    #    a batched request's tree is reachable via `report.py --explain
+    #    --trace-id` (the sampler drops whole roots, so ANY surviving
+    #    id suffices)
+    rows = [dict(s, kind="span") for s in streamed]
+    agg_view = report_mod.explain_view(rows)
+    if agg_view is None or "plan algo=" not in agg_view:
+        fails.append("no sort.plan span in the server stream (explain "
+                     "view empty)")
+    traced_ok = False
+    for t in tids:
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            rc = report_mod.main(["--explain", "--trace-id", t,
+                                  str(trace_path)])
+        if rc == 0 and "plan algo=" in buf.getvalue():
+            traced_ok = True
+            print(buf.getvalue())
+            break
+    if tids and not traced_ok:
+        fails.append("no live-req trace id resolves to a plan via "
+                     "--explain --trace-id (batch_id linkage broken?)")
+    # 2. regret metrics appear in the /metrics scrape (the span-close
+    #    bridge maps sort.plan onto the registered families)
+    for name in ("sort_plans_total", "sort_plan_regret"):
+        fam = fams.get(name)
+        if not fam or not fam["samples"]:
+            fails.append(f"/metrics: expected {name} after served "
+                         "requests (plan bridge dead?)")
+    # 3. the acceptance comparison, in-process on a skewed 2-device
+    #    mesh: SORT_NEGOTIATE=off must export strictly MORE cap regret
+    #    than the negotiated run, whose explain tree shows the restage
+    #    decision with predicted peer-need vs measured recv bytes
+    from mpitest_tpu.utils.platform import ensure_virtual_cpu_devices
+
+    ensure_virtual_cpu_devices(2)
+    from mpitest_tpu.models.api import sort
+    from mpitest_tpu.parallel.mesh import make_mesh
+    from mpitest_tpu.utils.metrics_live import (LiveMetrics,
+                                                SpanMetricsBridge)
+    from mpitest_tpu.utils.trace import Tracer
+
+    mesh = make_mesh(2)
+    x = np.arange(1 << 15, dtype=np.int32)   # arrangement-skewed
+
+    def one(**env):
+        m = LiveMetrics()
+        tr = Tracer()
+        tr.spans.observers.append(SpanMetricsBridge(m))
+        with knobs.scoped_env(SORT_RESTAGE_RATIO="1.5",
+                              SORT_TRACE_SAMPLE=None, **env):
+            sort(x, algorithm="sample", mesh=mesh, tracer=tr)
+        return m, tr
+
+    m_on, tr_on = one()
+    m_off, _tr_off = one(SORT_NEGOTIATE="off")
+    on_regret = m_on.gauge("sort_plan_cap_regret").get()
+    off_regret = m_off.gauge("sort_plan_cap_regret").get()
+    if not off_regret > on_regret:
+        fails.append(f"SORT_NEGOTIATE=off cap regret {off_regret} not "
+                     f"above negotiated {on_regret}")
+    else:
+        log(f"cap regret: negotiated {on_regret} < off {off_regret} "
+            "(negotiation visibly earns its keep)")
+    view = report_mod.explain_view(
+        [dict(s.to_dict(), kind="span") for s in tr_on.spans.spans])
+    for needle in ("restage", "chosen=True", "peer_recv_bytes",
+                   "need="):
+        if view is None or needle not in view:
+            fails.append(f"negotiated explain tree missing {needle!r}")
+    if view is not None:
+        print(view)
+    return fails
 
 
 def run(out: Path) -> int:
@@ -219,6 +318,9 @@ def run(out: Path) -> int:
                for r in ring_rows):
         fails.append("no serve.batch span with trace_ids in the flight "
                      "ring (batch membership not reconstructable)")
+
+    # -- explain leg (ISSUE 12) ---------------------------------------
+    fails.extend(explain_leg(streamed, tids, fams, srv.trace))
 
     if fails:
         for f in fails:

@@ -85,6 +85,12 @@ EXIT_RETRIES = 4
 
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv if argv is None else argv
+    # --explain (ISSUE 12): print the run's decision tree (plan
+    # provenance) after the sort.  A flag, not a positional — the
+    # byte-compatible reference argv contract stays untouched without it.
+    explain = "--explain" in argv
+    if explain:
+        argv = [a for a in argv if a != "--explain"]
     if len(argv) not in (2, 3):
         print(f"Usage: {argv[0]} <file: Data file to read>", file=sys.stderr)
         return 1
@@ -135,6 +141,8 @@ def main(argv: list[str] | None = None) -> int:
             # errors, so garbage dies here, not mid-sort
             "SORT_TRACE_SAMPLE", "SORT_FLIGHT_RECORDER_SIZE",
             "SORT_FLIGHT_RECORDER_DIR",
+            # plan provenance (ISSUE 12): minted on every run by default
+            "SORT_PLAN",
         )
         # resolve the encode engine NOW: SORT_NATIVE_ENCODE=on with no
         # usable libencode.so is one clean [ERROR] line here, never a
@@ -274,6 +282,16 @@ def main(argv: list[str] | None = None) -> int:
     else:
         print(f"The n/2-th sorted element: {int(med)}")
     print(f"Endtime()-Starttime() = {end - start:.5f} sec", file=sys.stderr)
+    if explain:
+        # the same renderer report.py --explain uses, fed from this
+        # run's in-process span log — no trace file required
+        from mpitest_tpu.report import explain_view
+
+        rows = [dict(s.to_dict(), kind="span")
+                for s in tracer.spans.spans]
+        view = explain_view(rows)
+        print(view if view is not None
+              else "(no plan recorded — SORT_PLAN=off)")
     return 0
 
 

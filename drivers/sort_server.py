@@ -94,6 +94,9 @@ def main(argv: list[str] | None = None) -> int:
             "SORT_FALLBACK", "SORT_FAULTS", "SORT_FAULTS_SEED",
             "SORT_LOCAL_ENGINE", "SORT_NEGOTIATE", "SORT_RESTAGE",
             "SORT_RESTAGE_RATIO", "SORT_NATIVE_ENCODE",
+            # plan provenance (ISSUE 12): the decision record behind
+            # the response header's plan digest and /varz snapshot
+            "SORT_PLAN",
         )
         from mpitest_tpu.utils import native_encode
 

@@ -40,6 +40,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from mpitest_tpu import compat, faults
+from mpitest_tpu.models import plan as plan_mod
 from mpitest_tpu.models import radix_sort, sample_sort
 from mpitest_tpu.models import supervisor as supervision
 from mpitest_tpu.models import verify as vfy
@@ -947,6 +948,43 @@ def _device_mem_high_water(span: Any, mesh: Mesh | None) -> None:
         span.attrs["device_mem_peak_bytes"] = peak
 
 
+def _finish_plan(tracer: Tracer, plan: "plan_mod.SortPlan | None") -> None:
+    """Seal and emit the run's decision record (ISSUE 12): default the
+    engine/restage decisions from what the counters already know, fold
+    the per-decision regrets, stamp the scalars into ``tracer.counters``
+    (the bench rows read them), and emit the registered ``sort.plan``
+    point event — the record ``report.py --explain``, the live regret
+    metrics and the ``/varz`` decision snapshot all consume."""
+    if plan is None:
+        return
+    c = tracer.counters
+    engine = c.get("local_engine")
+    if engine is not None:
+        if "engine" in plan.decisions:
+            plan.actual("engine", local_engine=str(engine))
+        else:
+            plan.decide("engine", chosen=str(engine))
+    fallbacks = (int(c.get("pair_residual_fallback", 0))
+                 - int(getattr(plan, "fallbacks_base", 0)))
+    if fallbacks > 0:
+        plan.actual("engine", fallbacks=fallbacks)
+    if plan.ranks and plan.ranks > 1 and "restage" not in plan.decisions:
+        # never restaged: its regret is every overflow regrow a
+        # re-stage would have prevented (stamped by the supervisor)
+        cap_d = plan.decisions.get("cap")
+        plan.decide("restage", chosen=False)
+        plan.actual("restage",
+                    regrows=(cap_d.actual.get("regrows", 0)
+                             if cap_d is not None else 0))
+    total = plan.finalize()
+    tracer.counters["plan_regret"] = total
+    cap_d = plan.decisions.get("cap")
+    if cap_d is not None and cap_d.regret is not None:
+        tracer.counters["plan_cap_regret"] = cap_d.regret
+    tracer.spans.event("sort.plan", **plan.to_attrs())
+    tracer.plan = plan
+
+
 def ingest_to_mesh(
     x: Any,
     mesh: Mesh | None = None,
@@ -1020,6 +1058,21 @@ def sort(
     # registry) — active for the whole run so the ingest/exchange hooks
     # see it; None in production is a no-op.
     reg = faults.for_run()
+    # Plan provenance (ISSUE 12): ONE decision record per run, minted
+    # here and carried on the tracer so every chokepoint below — algo
+    # reroutes, negotiation, re-stage, the supervisor's regrow loop,
+    # the fallback ladder — stamps into the same object.  SORT_PLAN=off
+    # restores the PR 8 behavior.
+    plan = (plan_mod.SortPlan(algo=algorithm,
+                              dtype=str(getattr(x, "dtype", "")) or None)
+            if plan_mod.enabled() else None)
+    if plan is not None:
+        # tracer counters accumulate across runs on a reused Tracer
+        # (the serve dispatch thread): snapshot the fallback tally so
+        # _finish_plan stamps THIS run's delta, not server-lifetime sums
+        plan.fallbacks_base = int(
+            tracer.counters.get("pair_residual_fallback", 0))
+    tracer.plan = plan
     with tracer.spans.span(
         "sort", algorithm=algorithm,
         n=int(size) if size is not None else None,
@@ -1028,6 +1081,7 @@ def sort(
         try:
             out = _sort_impl(x, algorithm, mesh, digit_bits, cap_factor,
                              oversample, tracer, return_result, pack, reg)
+            _finish_plan(tracer, plan)
         except supervision.SortFaultError as e:
             # ISSUE 10: a typed terminal error leaves an artifact — the
             # flight recorder's last-N spans (this run's retries, fault
@@ -1130,6 +1184,19 @@ def _sort_impl(
     n_ranks = int(mesh.devices.size)
     n = max(1, math.ceil(N / n_ranks))
 
+    # ---- plan provenance (ISSUE 12): the run's decision record ------
+    plan = tracer.plan if isinstance(tracer.plan, plan_mod.SortPlan) \
+        else None
+    if plan is not None:
+        plan.n = N
+        plan.ranks = n_ranks
+        plan.decide("algo", chosen=algorithm, requested=algorithm)
+        if staged is None and not is_device:
+            # host input: sortedness / run-length / duplicate profile
+            # from a ~1k strided sample (no extra key movement; the
+            # probe adds entropy/skew once the histogram materializes)
+            plan.profile.update(plan_mod.profile_host_array(x))
+
     verify_on = supervision.verify_enabled()
     # Wire fault telemetry BEFORE any word staging: the ingest_poison
     # site fires inside the streaming pipeline, long before the
@@ -1168,6 +1235,11 @@ def _sort_impl(
         """Verify-and-return for the single-device paths.  No ladder
         here (the degradation machinery targets the distributed
         dispatch); a verification failure is a typed error."""
+        if plan is not None:
+            # single-device runs have no distributed ladder: the rung
+            # is the fused local path itself (the engine decision is
+            # defaulted from the counters at _finish_plan time)
+            plan.decide("ladder", chosen="local")
         if verify_on and not _check_result(res_l, fp_l):
             raise SortIntegrityError(
                 "single-device sort result failed verification")
@@ -1338,6 +1410,8 @@ def _sort_impl(
 
     pack_impl = _resolve_pack(pack)
     align = _cap_align(pack_impl)
+    if plan is not None:
+        plan.decide("engine", chosen=pack_impl)
     # Donate the input word buffers to the SPMD program where the
     # backend profits (HBM aliasing) and the input can be rebuilt for
     # overflow retries (a donated buffer is dead after the dispatch).
@@ -1349,7 +1423,7 @@ def _sort_impl(
         staged.consumed = True
 
     # ---- robustness layer (ISSUE 3): supervisor + input fingerprint --
-    sup = SortSupervisor(tracer, registry=reg)
+    sup = SortSupervisor(tracer, registry=reg, plan=plan)
     input_fp = None
     if verify_on:
         with tracer.phase("verify"):
@@ -1503,17 +1577,31 @@ def _sort_impl(
         the count matrix describing the arrangement the sort will
         actually exchange."""
         cnts = _probe(kind, db)
+        if plan is not None:
+            # the probe's [P, P] histogram is already materialized —
+            # the input-distribution profile rides it for free
+            plan.profile.update(plan_mod.profile_from_counts(cnts, fair))
+        ratio = float(cnts.max()) / fair
         if (restage_on and not _restaged["done"]
-                and float(cnts.max()) / fair >= restage_ratio):
+                and ratio >= restage_ratio):
             tracer.verbose(
                 f"{kind} probe: per-peer need {int(cnts.max())} >= "
                 f"{restage_ratio:g}x fair share {fair}; re-staging")
+            if plan is not None:
+                plan.decide("restage", chosen=True, trigger="probe",
+                            peer_ratio=round(ratio, 4))
             do_restage()
             cnts = _probe(kind, db)
+            if plan is not None:
+                plan.actual("restage",
+                            peer_ratio=round(float(cnts.max()) / fair, 4))
         return cnts
 
     def run_radix(cap0: int) -> DistributedSortResult:
         db, passes = radix_plan()
+        if plan is not None:
+            plan.decide("passes", chosen=passes, passes=passes,
+                        digit_bits=db)
         if negotiate and passes > 0:
             cnts = _negotiate("radix", db)
             need = _round_cap(int(cnts.max()), align)
@@ -1522,7 +1610,14 @@ def _sort_impl(
             # cap_factor floor and the regrow loop as backstop instead
             # of risking a full re-run to undercut it.
             cap0 = need if passes == 1 else max(need, cap0)
+            if plan is not None:
+                plan.decide("cap", chosen=cap0, trigger="exact",
+                            cap=cap0, need=int(cnts.max()), fair=fair)
             _balance_event(cnts, "radix", True, cap0, _restaged["done"])
+        elif plan is not None:
+            plan.decide("cap", chosen=cap0, trigger="off", cap=cap0,
+                        fair=fair)
+        last_need = {"v": None}
 
         def attempt(c: int):
             fn = _compile_radix(mesh, codec.n_words, n, db, c, passes,
@@ -1533,6 +1628,7 @@ def _sort_impl(
                     n=n, cap=c, passes=passes, digit_bits=db, ranks=n_ranks)
                 mark_dead()
                 max_cnt = int(max_cnt)
+            last_need["v"] = max_cnt
             # Exchange accounting (SURVEY.md §5 metrics row), counted per
             # attempt so discarded overflow retries — whose all_to_all
             # traffic really crossed the links — are included: the padded
@@ -1551,6 +1647,13 @@ def _sort_impl(
         tracer.count("exchange_passes", passes)
         tracer.counters["exchange_cap"] = cap  # last cap, not accumulated
         tracer.counters["digit_bits"] = db     # auto-resolved width
+        if plan is not None:
+            # actual side of the cap decision: the measured per-peer
+            # need and its wire-byte size (vs the probe's prediction)
+            plan.actual("cap", cap=cap, need=last_need["v"],
+                        peer_recv_bytes=(last_need["v"] or 0)
+                        * 4 * codec.n_words)
+            plan.actual("passes", passes=passes)
         return DistributedSortResult(out, N, dtype)
 
     def run_sample() -> DistributedSortResult:
@@ -1571,6 +1674,8 @@ def _sort_impl(
                 "routing to radix (skew-immune)"
             )
             tracer.count("sample_skew_fallback", 1)
+            if plan is not None:
+                plan.decide("algo", chosen="radix", trigger="skew_sniff")
             return run_radix(skew_cap)
         cap_limit = _round_cap(SAMPLE_CAP_LIMIT_FACTOR * fair, align)
         cap_start = base_cap
@@ -1589,14 +1694,25 @@ def _sort_impl(
                     f"sample probe estimates cap {need} > O(n) bound "
                     f"{cap_limit}; routing to radix (skew-immune)")
                 tracer.count("sample_skew_fallback", 1)
+                if plan is not None:
+                    plan.decide("algo", chosen="radix",
+                                trigger="probe_estimate")
                 return run_radix(skew_cap)
             cap_start = need
+            if plan is not None:
+                plan.decide("cap", chosen=cap_start, trigger="estimate",
+                            cap=cap_start, need=int(cnts.max()), fair=fair)
             _balance_event(cnts, "sample", False, cap_start,
                            _restaged["done"])
+        elif plan is not None:
+            plan.decide("cap", chosen=cap_start, trigger="off",
+                        cap=cap_start, fair=fair)
         spmd_engine = (_bitonic_impl() if _use_bitonic(_local_engine(),
                                                        codec.n_words, n)
                        else "lax")
         tracer.counters["local_engine"] = spmd_engine
+
+        last_need = {"v": None}
 
         def attempt(c: int):
             fn = _compile_sample(mesh, codec.n_words, n, c, oversample,
@@ -1608,6 +1724,7 @@ def _sort_impl(
                     n=n, cap=c, ranks=n_ranks)
                 mark_dead()
                 max_cnt = int(max_cnt)
+            last_need["v"] = max_cnt
             tracer.count(
                 "exchange_bytes",
                 n_ranks * (n_ranks - 1) * c * 4 * codec.n_words,
@@ -1625,9 +1742,19 @@ def _sort_impl(
                 f"{e.limit}; routing to radix (skew-immune)"
             )
             tracer.count("sample_skew_fallback", 1)
+            if plan is not None:
+                # the LATE reroute: a full exchange ran and busted the
+                # bound before the switch — the regret the up-front
+                # sniff/probe reroutes exist to avoid
+                plan.decide("algo", chosen="radix", trigger="cap_exceeded")
+                plan.actual("algo", late_reroute=True)
             return run_radix(skew_cap)
         tracer.count("exchange_passes", 1)
         tracer.counters["exchange_cap"] = cap
+        if plan is not None:
+            plan.actual("cap", cap=cap, need=last_need["v"],
+                        peer_recv_bytes=(last_need["v"] or 0)
+                        * 4 * codec.n_words)
         return DistributedSortResult(
             out, N, dtype, counts=np.asarray(counts),
             shard_slots=n_ranks * cap
@@ -1670,6 +1797,8 @@ def _sort_impl(
     if supervision.fallback_enabled():
         levels.append("sample" if algorithm == "radix" else "radix")
         levels.append("host")
+    if plan is not None:
+        plan.decide("ladder", chosen=levels[0])
 
     res = None
     host_words = None
@@ -1678,6 +1807,9 @@ def _sort_impl(
     for level in levels:
         if level != levels[0]:
             tracer.verbose(f"degrading to the {level} path")
+            if plan is not None:
+                plan.decide("ladder", chosen=level)
+                plan.bump("ladder", "rungs_descended")
         done = False
         for ver_try in range(2 if verify_on else 1):
             try:

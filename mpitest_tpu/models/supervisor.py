@@ -46,6 +46,7 @@ from mpitest_tpu import faults as flt
 from mpitest_tpu.utils import knobs
 
 if TYPE_CHECKING:
+    from mpitest_tpu.models.plan import SortPlan
     from mpitest_tpu.utils.trace import Tracer
 
 
@@ -124,9 +125,14 @@ class SortSupervisor:
     hookup, and the shared cap-regrow loop.  One instance per sort()."""
 
     def __init__(self, tracer: "Tracer",
-                 registry: "flt.FaultRegistry | None" = None) -> None:
+                 registry: "flt.FaultRegistry | None" = None,
+                 plan: "SortPlan | None" = None) -> None:
         self.tracer = tracer
         self.registry = registry
+        #: decision record (ISSUE 12): the supervisor is the layer that
+        #: KNOWS how wrong a sizing decision was — overflow regrows and
+        #: dispatch retries stamp their counts onto the plan here.
+        self.plan = plan
         self.max_retries = max_retries()
         self.backoff = retry_backoff()
         wire_registry(registry, tracer)
@@ -203,6 +209,8 @@ class SortSupervisor:
                     f"{label} dispatch failed ({type(e).__name__}); "
                     f"retry {attempt + 1}/{self.max_retries} in {delay:.2f}s")
                 self.tracer.count("sort_retries", 1)
+                if self.plan is not None:
+                    self.plan.bump("ladder", "dispatch_retries")
                 self.tracer.spans.record(
                     "supervisor_retry", time.perf_counter(), 0.0,
                     label=label, attempt=attempt + 1,
@@ -246,10 +254,17 @@ class SortSupervisor:
             if cap_limit is not None and need > cap_limit:
                 raise ExchangeCapExceeded(max_cnt, cap_limit)
             regrows += 1
+            if self.plan is not None:
+                # each regrow is a full discarded exchange dispatch —
+                # the unit of cap-regret the explain view reports
+                self.plan.bump("cap", "regrows")
             if re_stage is not None and regrows >= 2:
                 self.tracer.verbose(
                     f"{label} exchange overflowed {regrows} times "
                     "(persistent imbalance); re-staging shards")
+                if self.plan is not None:
+                    self.plan.decide("restage", chosen=True,
+                                     trigger="overflow")
                 re_stage()
                 re_stage = None  # once per run
             self.tracer.verbose(

@@ -48,6 +48,7 @@ from mpitest_tpu.utils.span_schema import (BALANCE_SPAN,
                                            FAULT_SPAN,
                                            INGEST_HOST_STAGES,
                                            INGEST_XFER_STAGES, PHASE_PREFIX,
+                                           PLAN_SPAN,
                                            RESTAGE_SPAN, RETRY_SPAN,
                                            SERVE_BATCH_SPAN,
                                            SERVE_CACHE_SPAN,
@@ -71,6 +72,14 @@ INGEST_RATIO_GATE = 0.5
 #: Default availability SLO target for the error-budget line (ISSUE 10):
 #: at 99.9%, an 0.1% error rate burns the budget at exactly 1.0x.
 DEFAULT_SLO_TARGET_PCT = 99.9
+
+#: Absolute floor when comparing a CURRENT plan_regret against a pinned
+#: one (ISSUE 12): a pin of 0.0 is the common clean-run value, and a
+#: pure ratio band would either never flag (pin=0 skip) or flag on
+#: meaningless near-zero jitter.  Same rationale as
+#: tools/bench_history.py LOWER_BEST_FLOOR (kept separate: that tool is
+#: import-light by design and must not pull this package).
+PLAN_REGRET_FLOOR = 0.25
 
 
 # --------------------------------------------------------------- loading
@@ -474,6 +483,97 @@ def trace_view(rows: list[dict], trace_id: str) -> str | None:
     return "\n".join(out)
 
 
+# --------------------------------------------------- EXPLAIN (plans)
+
+def _fmt_kv(d: dict) -> str:
+    return " ".join(f"{k}={v}" for k, v in sorted(d.items()))
+
+
+def render_plan(attrs: dict) -> list[str]:
+    """One ``sort.plan`` record as an EXPLAIN-ANALYZE-style tree:
+    decision → inputs → prediction → actual → regret, one branch per
+    registered decision (models/plan.py vocabulary)."""
+    head = (f"plan algo={attrs.get('algo')} n={attrs.get('n')} "
+            f"dtype={attrs.get('dtype')} ranks={attrs.get('ranks')} "
+            f"regret={attrs.get('regret')}")
+    tid = attrs.get(TRACE_ID_ATTR)
+    if tid:
+        head += f" trace_id={tid}"
+    out = [head]
+    profile = attrs.get("profile") or {}
+    if profile:
+        out.append(f"  profile: {_fmt_kv(profile)}")
+    decisions = attrs.get("decisions") or {}
+    names = sorted(decisions)
+    for i, name in enumerate(names):
+        d = decisions[name]
+        if not isinstance(d, dict):
+            continue
+        branch = "└─" if i == len(names) - 1 else "├─"
+        line = f"  {branch} {name:<8} chosen={d.get('chosen')}"
+        if d.get("requested") is not None \
+                and d.get("requested") != d.get("chosen"):
+            line += f" (requested={d['requested']})"
+        if d.get("trigger") is not None:
+            line += f" trigger={d['trigger']}"
+        line += f" regret={d.get('regret', 0)}"
+        out.append(line)
+        pad = "     " if i == len(names) - 1 else "  │  "
+        if d.get("predicted"):
+            out.append(f"  {pad}predicted: {_fmt_kv(d['predicted'])}")
+        if d.get("actual"):
+            out.append(f"  {pad}actual:    {_fmt_kv(d['actual'])}")
+    return out
+
+
+def explain_view(rows: list[dict], trace_id: str | None = None,
+                 ) -> str | None:
+    """The ``--explain`` surface (ISSUE 12).  With a ``trace_id``:
+    render every plan that request produced (its own dispatch, or the
+    packed dispatch it shared via ``batch_id``) as full decision trees.
+    Without one: every plan in the files as trees PLUS the aggregate
+    regret table per decision — mis-sized caps and wasted restages as
+    one ranked summary.  None when no ``sort.plan`` span is present."""
+    plans = [r for r in rows if r.get("kind") == "span"
+             and r.get("name") == PLAN_SPAN]
+    if trace_id is not None:
+        batch_ids = {
+            s["attrs"][BATCH_ID_ATTR]
+            for s in rows
+            if s.get("kind") == "span"
+            and s.get("attrs", {}).get(TRACE_ID_ATTR) == trace_id
+            and s.get("attrs", {}).get(BATCH_ID_ATTR) is not None}
+        for s in rows:
+            if s.get("kind") == "span" and s.get("name") == SERVE_BATCH_SPAN:
+                tids = s.get("attrs", {}).get(BATCH_TRACE_IDS_ATTR) or []
+                bid = s.get("attrs", {}).get(BATCH_ID_ATTR)
+                if trace_id in tids and bid is not None:
+                    batch_ids.add(bid)
+        plans = [p for p in plans
+                 if p.get("attrs", {}).get(TRACE_ID_ATTR) == trace_id
+                 or p.get("attrs", {}).get(BATCH_ID_ATTR) in batch_ids]
+    if not plans:
+        return None
+    out: list[str] = []
+    for p in plans:
+        out.extend(render_plan(p.get("attrs") or {}))
+        out.append("")
+    if trace_id is None and len(plans) > 1:
+        from mpitest_tpu.models.plan import fold_decision_stats
+
+        agg = fold_decision_stats([p.get("attrs") or {} for p in plans])
+        out.append(f"aggregate regret over {len(plans)} plan(s)")
+        out.append(f"  {'decision':<10} {'count':>6} {'mean':>10} "
+                   f"{'max':>10}")
+        for name, row in sorted(agg.items(),
+                                key=lambda kv: -kv[1]["regret_sum"]):
+            out.append(
+                f"  {name:<10} {row['count']:>6} "
+                f"{row['regret_sum'] / row['count']:>10.4f} "
+                f"{row['regret_max']:>10.4f}")
+    return "\n".join(out).rstrip()
+
+
 # --------------------------------------------- live metrics snapshots
 
 def render_prom_snapshot(path: str, text: str,
@@ -569,6 +669,42 @@ def flag_regressions(current: dict, baseline_rows: list[dict],
                              "current": val, "pinned": pinned,
                              "ratio": round(val / pinned, 3)
                              if pinned else None})
+        # decision drift (ISSUE 12): a row that pinned its plan digest
+        # also pins the DECISIONS behind the number — same throughput
+        # from a different algo/cap/restage is drift worth flagging
+        # even when no throughput gate fires.
+        for key in ("restaged", "negotiated_cap", "plan_regret"):
+            if key not in row:
+                continue
+            cur_v, pin_v = cur.get(key), row[key]
+            if cur_v is None:
+                findings.append({"metric": f"{name}.{key}",
+                                 "status": "missing",
+                                 "reason": "pinned plan field absent "
+                                           "from the current row"})
+            elif key == "restaged":
+                if bool(cur_v) != bool(pin_v):
+                    findings.append({
+                        "metric": f"{name}.{key}", "status": "DRIFT",
+                        "reason": f"restage decision flipped "
+                                  f"(pinned {bool(pin_v)}, "
+                                  f"current {bool(cur_v)})"})
+            elif key == "plan_regret":
+                # lower is better, and a clean pin of 0.0 must still
+                # gate later regret — compare against pin-or-floor
+                floor = max(float(pin_v), PLAN_REGRET_FLOOR)
+                if float(cur_v) > floor / threshold:
+                    findings.append({
+                        "metric": f"{name}.{key}", "status": "DRIFT",
+                        "reason": f"pinned {pin_v}, current {cur_v} "
+                                  f"(allowed <= {floor / threshold:.3g})"})
+            elif float(pin_v) > 0 and not (
+                    threshold * float(pin_v) <= float(cur_v)
+                    <= float(pin_v) / threshold):
+                findings.append({
+                    "metric": f"{name}.{key}", "status": "DRIFT",
+                    "reason": f"pinned {pin_v}, current {cur_v} "
+                              f"({float(cur_v) / float(pin_v):.2f}x)"})
     return findings
 
 
@@ -808,6 +944,17 @@ def main(argv: list[str] | None = None) -> int:
                          "batch membership, dispatch, verify and reply "
                          "spans as a timeline; exit 1 when no span "
                          "carries the id")
+    ap.add_argument("--explain", nargs="?", const="", default=None,
+                    metavar="TRACE_ID|FILE",
+                    help="plan provenance (ISSUE 12): render sort.plan "
+                         "decision records as EXPLAIN-ANALYZE-style "
+                         "trees (decision → prediction → actual → "
+                         "regret).  The optional value is a trace id "
+                         "(one request's plans) or a span file to read; "
+                         "bare --explain renders every plan in the "
+                         "given files plus the aggregate regret table. "
+                         "Combine with --trace-id to scope; exit 1 when "
+                         "no plan matches")
     ap.add_argument("--prom", action="append", default=[],
                     metavar="FILE",
                     help="live mode: render a scraped /metrics snapshot "
@@ -821,6 +968,20 @@ def main(argv: list[str] | None = None) -> int:
     args = ap.parse_args(argv)
 
     files = list(args.files)
+    explain_tid: str | None = None
+    if args.explain is not None and args.explain:
+        # the one optional value is either a span FILE or a trace id;
+        # a path-shaped value that does not exist is a missing file,
+        # not a trace id (trace ids are [A-Za-z0-9_-]{1,64} — they can
+        # never contain a slash or a .jsonl suffix)
+        if Path(args.explain).exists():
+            files.append(args.explain)
+        elif "/" in args.explain or args.explain.endswith(".jsonl"):
+            print(f"[ERROR] --explain: {args.explain}: no such file",
+                  file=sys.stderr)
+            return 1
+        else:
+            explain_tid = args.explain
     if not files and not args.prom:
         default = Path("bench/BASELINE_RESULTS.jsonl")
         if default.exists():
@@ -835,6 +996,18 @@ def main(argv: list[str] | None = None) -> int:
         except OSError as e:
             print(f"[ERROR] {f}: {e}", file=sys.stderr)
             return 1
+
+    if args.explain is not None:
+        tid = explain_tid or args.trace_id
+        view = explain_view(rows, tid)
+        if view is None:
+            where = f" for trace_id {tid!r}" if tid else ""
+            print(f"[ERROR] no sort.plan span{where} across "
+                  f"{len(files)} file(s) (SORT_PLAN=off, or the run "
+                  "predates plan provenance)", file=sys.stderr)
+            return 1
+        print(view)
+        return 0
 
     if args.trace_id is not None:
         view = trace_view(rows, args.trace_id)

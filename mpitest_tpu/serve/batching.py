@@ -75,6 +75,13 @@ class ServeRequest:
     #: dispatch) — set by fail_deadline, read by the serve.deadline
     #: span emission.
     deadline_stage: str | None = None
+    #: batching-window decision record (ISSUE 12): why the window
+    #: closed, members collected, keys at close — the predicted side of
+    #: the batch/bucket decision the server's plan stamps actuals onto.
+    window: dict | None = None
+    #: compact plan digest of the dispatch that served this request
+    #: (models/plan.py SortPlan.digest()) — echoed in the wire response.
+    plan: dict | None = None
 
     @property
     def n(self) -> int:
@@ -101,11 +108,14 @@ class ServeRequest:
             self.queue_s = time.perf_counter() - self.t_enq
 
     def complete(self, out: np.ndarray, batched: bool,
-                 bucket: int | None, batch_id: str | None = None) -> None:
+                 bucket: int | None, batch_id: str | None = None,
+                 plan: dict | None = None) -> None:
         self.result = out
         self.batched = batched
         self.bucket = bucket
         self.batch_id = batch_id
+        if plan is not None:
+            self.plan = plan
         self.done.set()
 
     def fail(self, code: str, detail: str) -> None:
@@ -272,6 +282,8 @@ class Batcher:
                 continue
             batch = [req]
             total = req.n
+            closed_by = "keys" if total >= self.batch_keys else (
+                "solo" if self.window_s <= 0 else "window")
             if self.window_s > 0:
                 # the window closes at the EARLIEST member deadline,
                 # less dispatch headroom (10% of the member's remaining
@@ -281,15 +293,25 @@ class Batcher:
                 # dispatch a request already dead on arrival
                 now = time.monotonic()
                 close = now + self.window_s
+                #: True once a member deadline shortened the window —
+                #: a time-based close is then a "deadline" close, not a
+                #: full "window" (the plan's trigger must say which)
+                deadline_clamped = False
                 if req.deadline is not None:
-                    close = min(close, self._deadline_close(req, now))
+                    dc = self._deadline_close(req, now)
+                    if dc < close:
+                        close, deadline_clamped = dc, True
                 while total < self.batch_keys:
                     slack = close - time.monotonic()
                     if slack <= 0:
+                        if deadline_clamped:
+                            closed_by = "deadline"
                         break
                     try:
                         nxt = self._q.get(timeout=slack)
                     except queue.Empty:
+                        if deadline_clamped:
+                            closed_by = "deadline"
                         break
                     if nxt is _STOP:
                         self._stopping = True
@@ -304,9 +326,13 @@ class Batcher:
                             and total + cand.n <= self.batch_keys):
                         batch.append(cand)
                         total += cand.n
+                        if total >= self.batch_keys:
+                            closed_by = "keys"
                         if cand.deadline is not None:
-                            close = min(close, self._deadline_close(
-                                cand, time.monotonic()))
+                            dc = self._deadline_close(cand,
+                                                      time.monotonic())
+                            if dc < close:
+                                close, deadline_clamped = dc, True
                     else:
                         # incompatible (dtype mix, solo-only, or the
                         # batch would overflow): set it aside for the
@@ -315,6 +341,13 @@ class Batcher:
                         # 2 ms window
                         with self._pending_lock:
                             self._pending.append(cand)
+                        # a same-dtype batchable candidate can only be
+                        # deferred by the capacity bound — that is a
+                        # "keys" (full) close, not an incompatibility
+                        closed_by = ("keys" if (cand.batchable
+                                                and cand.faults is None
+                                                and cand.dtype == req.dtype)
+                                     else "incompatible")
                         break
             # final deadline sweep AFTER the window: members that
             # expired while the pack collected are cancelled here, so
@@ -325,6 +358,16 @@ class Batcher:
                      if not self._cancel_if_expired(r, "dispatch")]
             if not batch:
                 continue
+            # window decision record (ISSUE 12): why this pack closed
+            # and what it will actually dispatch — keys recounted AFTER
+            # the deadline sweep above, or the batch plan's predicted
+            # bucket/waste would be computed from members that were
+            # cancelled and never shipped
+            window = {"members": len(batch),
+                      "keys": sum(r.n for r in batch),
+                      "closed_by": closed_by}
+            for r in batch:
+                r.window = window
             # window 0 degenerates to per-request dispatch — still
             # through the packed path, so the executor cache serves the
             # sequential mode warm too (the A/B the selftest measures)

@@ -73,6 +73,14 @@ class ServeReply:
         v = self.header.get("trace_id")
         return str(v) if v is not None else None
 
+    @property
+    def plan(self) -> dict | None:
+        """Compact decision digest of the dispatch that served this
+        request (ISSUE 12): algo, negotiated cap, restage verdict,
+        regret — the client-visible decision-drift signal."""
+        v = self.header.get("plan")
+        return v if isinstance(v, dict) else None
+
 
 class ServeClient:
     """One persistent connection to a sort server.  ``timeout`` bounds

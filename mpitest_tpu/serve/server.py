@@ -12,9 +12,11 @@ requests back to back):
   server runs with ``SORT_SERVE_ALLOW_FAULTS=1``) — followed by exactly
   ``n * itemsize`` raw little-endian key bytes.
 * response: one JSON header line — ``{"ok": true, "n": ..., "batched":
-  ..., "bucket": ..., "trace_id": ..., "batch_id": ...}`` followed by
-  the sorted key bytes, or ``{"ok": false, "error": <code>, "detail":
-  ..., "trace_id": ...}`` with no payload.  Error codes are TYPED and stable: ``bad_request`` (the
+  ..., "bucket": ..., "trace_id": ..., "batch_id": ..., "plan": ...}``
+  (``plan`` is the compact decision digest of ISSUE 12 — algo,
+  negotiated cap, restage verdict, regret — present when ``SORT_PLAN``
+  is on) followed by the sorted key bytes, or ``{"ok": false, "error":
+  <code>, "detail": ..., "trace_id": ...}`` with no payload.  Error codes are TYPED and stable: ``bad_request`` (the
   header/payload is malformed), ``backpressure`` (admission bounds hit
   or the circuit breaker is open — retry with backoff), ``draining``
   (SIGTERM received), ``deadline_exceeded`` (the request's optional
@@ -65,6 +67,7 @@ from typing import TYPE_CHECKING, Any, BinaryIO
 import numpy as np
 
 from mpitest_tpu import faults
+from mpitest_tpu.models import plan as plan_mod
 from mpitest_tpu.models import segmented
 from mpitest_tpu.models import supervisor as supervision
 from mpitest_tpu.serve.admission import AdmissionControl, AdmissionReject
@@ -253,7 +256,14 @@ class ServerCore:
                 finally:
                     if reg is not None:
                         faults.install(None)
-            req.complete(out, batched=False, bucket=None)
+            # plan digest (ISSUE 12): sort() left its finished decision
+            # record on the tracer (single dispatch thread — last write
+            # is this request's); the compact digest rides the response
+            # header so clients can watch decision drift
+            p = self.tracer.plan
+            req.complete(out, batched=False, bucket=None,
+                         plan=p.digest() if isinstance(
+                             p, plan_mod.SortPlan) else None)
         except supervision.SortIntegrityError as e:
             req.fail(ERR_INTEGRITY, str(e))
         except supervision.SortRetryExhausted as e:
@@ -326,10 +336,32 @@ class ServerCore:
                 attrs["device_mem_peak_bytes"] = peak
             self.tracer.spans.record(
                 "serve.batch", t0, time.perf_counter() - t0, **attrs)
+            # batch plan (ISSUE 12): the batching-window + bucket
+            # decision as a first-class plan record — predicted waste
+            # at window close vs the padded lanes actually shipped
+            digest = None
+            if plan_mod.enabled():
+                plan = plan_mod.SortPlan(algo="packed", n=batch.n_valid,
+                                         dtype=dtype.name, ranks=1)
+                w = next((r.window for r in reqs if r.window), None) or {}
+                keys_close = int(w.get("keys", batch.n_valid))
+                pred_bucket = segmented.bucket_for(keys_close)
+                plan.decide(
+                    "batch", chosen=batch.bucket,
+                    trigger=str(w.get("closed_by", "?")),
+                    members=int(w.get("members", len(reqs))),
+                    bucket=pred_bucket,
+                    waste=round(1.0 - keys_close / pred_bucket, 4))
+                plan.actual(
+                    "batch", keys=batch.n_valid,
+                    waste=round(1.0 - batch.n_valid / batch.bucket, 4))
+                plan.finalize()
+                self.tracer.spans.event("sort.plan", **plan.to_attrs())
+                digest = plan.digest()
             for r, ok, out in zip(reqs, verdicts, outs):
                 if ok:
                     r.complete(out, batched=True, bucket=batch.bucket,
-                               batch_id=batch_id)
+                               batch_id=batch_id, plan=digest)
                 else:
                     self.tracer.count("serve_segment_requeues", 1)
                     self.metrics.counter(
@@ -400,6 +432,8 @@ class ServerCore:
             attrs["bucket"] = req.bucket
         if req.batch_id is not None:
             attrs["batch_id"] = req.batch_id
+        if req.plan is not None:
+            attrs["plan"] = req.plan
         if req.queue_s is not None:
             attrs["queue_s"] = round(req.queue_s, 6)
         if req.error is not None:
@@ -736,6 +770,11 @@ class ServerCore:
                 "trace_id": tid}
         if attrs.get("batch_id") is not None:
             resp["batch_id"] = attrs["batch_id"]
+        if attrs.get("plan") is not None:
+            # compact decision digest (ISSUE 12): algo, negotiated cap,
+            # restage verdict, regret — decision drift is observable
+            # from the client side without the span stream
+            resp["plan"] = attrs["plan"]
         return resp, np.ascontiguousarray(result).tobytes(), True
 
     # -- lifecycle ----------------------------------------------------

@@ -118,6 +118,43 @@ class ProfileHook:
                     "failed": self.failed}
 
 
+def plan_snapshot(rows: "list[dict]") -> dict:
+    """Rolling decision snapshot (ISSUE 12) from the flight-recorder
+    ring: fold the ring's recent ``sort.plan`` spans into plan counts
+    per algorithm, mean/max regret per decision, and the latest plan's
+    compact view — the traffic profile the ROADMAP item-5 planner will
+    consume, already shaped for ``/varz``."""
+    from mpitest_tpu.models.plan import fold_decision_stats
+
+    plans = [r for r in rows if r.get("name") == "sort.plan"]
+    by_algo: dict[str, int] = {}
+    total_regret = 0.0
+    for p in plans:
+        attrs = p.get("attrs") or {}
+        algo = str(attrs.get("algo", "?"))
+        by_algo[algo] = by_algo.get(algo, 0) + 1
+        total_regret += float(attrs.get("regret", 0.0) or 0.0)
+    dec = fold_decision_stats([p.get("attrs") or {} for p in plans])
+    out: dict = {
+        "plans": len(plans),
+        "by_algo": by_algo,
+        "mean_regret": (round(total_regret / len(plans), 6)
+                        if plans else 0.0),
+        "decisions": {
+            name: {"count": row["count"],
+                   "mean_regret": round(row["regret_sum"] / row["count"],
+                                        6),
+                   "max_regret": round(row["regret_max"], 6)}
+            for name, row in sorted(dec.items())},
+    }
+    if plans:
+        last = plans[-1].get("attrs") or {}
+        out["last"] = {"algo": last.get("algo"),
+                       "regret": last.get("regret"),
+                       "profile": last.get("profile")}
+    return out
+
+
 def _set_knobs() -> dict[str, str]:
     """Every registered knob explicitly set in this process's
     environment (raw values) — the /varz configuration view.  Defaults
@@ -222,6 +259,9 @@ class _Handler(BaseHTTPRequestHandler):
                                 "recorded": rec.recorded,
                                 "dumps": rec.dumps,
                                 "dir": rec.directory},
+            # rolling decision snapshot (ISSUE 12), fed from the ring —
+            # the traffic profile the self-tuning planner will consume
+            "plans": plan_snapshot(rec.snapshot()),
             "profiler": core.profiler.state(),
             "requests": {"ok": core.requests_ok,
                          "err": core.requests_err},

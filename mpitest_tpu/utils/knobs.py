@@ -366,6 +366,15 @@ register("SORT_RESTAGE_RATIO", "float", 4.0, "a finite number > 1",
          "Per-peer max/fair-share count ratio that triggers a re-stage.",
          _parse_restage_ratio)
 
+# Plan provenance (ISSUE 12): every runtime decision (algo reroute,
+# negotiated cap, re-stage, engine, ladder rung, serve bucket) is
+# recorded with predicted-vs-actual quantities and a regret scalar —
+# the read side of the ROADMAP item-5 planner.
+register("SORT_PLAN", "enum", "on", "on | off",
+         "Decision provenance: mint SortPlan records, emit sort.plan "
+         "spans and the plan-regret metrics (off = PR 8 behavior).",
+         _enum("SORT_PLAN", ("on", "off")))
+
 # Observability sidecar paths (off when unset — the byte-compatible CLI
 # contract is untouched by default).
 register("SORT_TRACE", "path", None, "a writable file path",

@@ -125,6 +125,23 @@ METRICS: dict[str, tuple[str, str]] = {
         "counter", "On-demand jax.profiler captures taken."),
     "sort_flight_dumps_total": (
         "counter", "Flight-recorder artifacts dumped."),
+    # plan provenance (ISSUE 12): predicted-vs-actual regret per
+    # decision, exported live so mis-sized caps / wasted restages /
+    # wrong reroutes are visible in /metrics before they cost
+    # throughput.  Fed from sort.plan span closes by the bridge.
+    "sort_plans_total": (
+        "counter", "Finished plan records (label: algo)."),
+    "sort_plan_regret": (
+        "gauge", "Last plan's total predicted-vs-actual regret."),
+    "sort_plan_decision_regret": (
+        "gauge", "Last plan's regret per decision (label: decision)."),
+    "sort_plan_cap_regret": (
+        "gauge", "Last plan's exchange-cap regret (|cap-need|/need + "
+                 "overflow regrows) — rises when negotiation is off or "
+                 "mis-predicts."),
+    "sort_plan_reroutes_total": (
+        "counter", "Plans whose algorithm was rerouted away from the "
+                   "requested one (label: trigger)."),
 }
 
 _HISTOGRAM_BUCKETS: dict[str, tuple[float, ...]] = {
@@ -475,6 +492,31 @@ class SpanMetricsBridge:
         elif name == "fault":
             metrics.counter("sort_faults_total").inc(
                 1, site=str(attrs.get("site", "?")))
+        elif name == "sort.plan":
+            metrics.counter("sort_plans_total").inc(
+                1, algo=str(attrs.get("algo", "?")))
+            r = attrs.get("regret")
+            if r is not None:
+                metrics.gauge("sort_plan_regret").set(float(r))
+            decisions = attrs.get("decisions")
+            if isinstance(decisions, dict):
+                for dname, d in decisions.items():
+                    if not isinstance(d, dict):
+                        continue
+                    dr = d.get("regret")
+                    if dr is not None:
+                        metrics.gauge("sort_plan_decision_regret").set(
+                            float(dr), decision=str(dname))
+                cap = decisions.get("cap")
+                if isinstance(cap, dict) and cap.get("regret") is not None:
+                    metrics.gauge("sort_plan_cap_regret").set(
+                        float(cap["regret"]))
+                algo_d = decisions.get("algo")
+                if isinstance(algo_d, dict) and \
+                        algo_d.get("requested") is not None and \
+                        algo_d.get("chosen") != algo_d.get("requested"):
+                    metrics.counter("sort_plan_reroutes_total").inc(
+                        1, trigger=str(algo_d.get("trigger", "?")))
         elif name == "exchange_balance":
             for key, metric in (
                     ("recv_ratio", "sort_exchange_recv_ratio"),

@@ -87,6 +87,13 @@ SPAN_NAMES: dict[str, str] = {
                        "trail"),
     "serve.hedge": ("one client-side hedged request (winner=primary|"
                     "hedge, waited_ms) — the p99-tail second attempt"),
+    # plan provenance (ISSUE 12): one point event per finished sort (or
+    # packed serve dispatch) carrying the full decision record —
+    # decisions {algo, cap, restage, engine, passes, ladder, batch}
+    # with predicted/actual/regret, plus the input-distribution profile
+    # (models/plan.py is the registered decision vocabulary, SL005)
+    "sort.plan": ("one finished plan record (algo, regret, decisions, "
+                  "profile) — report.py --explain and /varz consume it"),
     # models/ingest.py — streamed pipeline stages (ISSUE 2)
     "ingest.parse": "parse/materialize one host chunk",
     "ingest.encode": "codec-encode one chunk (worker pool)",
@@ -121,6 +128,10 @@ SERVE_PROFILE_SPAN = "serve.profile"
 SERVE_DEADLINE_SPAN = "serve.deadline"
 SERVE_WATCHDOG_SPAN = "serve.watchdog"
 SERVE_HEDGE_SPAN = "serve.hedge"
+
+#: Plan-provenance name (ISSUE 12): the decision record report.py
+#: --explain renders and the /varz decision snapshot aggregates.
+PLAN_SPAN = "sort.plan"
 
 #: Request-trace attributes (ISSUE 10): the wire layer mints one
 #: ``trace_id`` per request (echoed in the response) and the dispatch

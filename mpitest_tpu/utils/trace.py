@@ -40,6 +40,11 @@ class Tracer:
     #: nested span here too, and sort() adds jit/collective/pass spans.
     #: ``SORT_TRACE=<path>`` streams it as JSONL (wired in models/api.py).
     spans: SpanLog = field(default_factory=SpanLog)
+    #: The LAST finished decision record (models/plan.py SortPlan) —
+    #: set by sort() at completion so drivers/serve can read the plan
+    #: digest without re-parsing the span stream.  One dispatch thread
+    #: per tracer by contract, so last-write is the right answer.
+    plan: object | None = None
 
     # -- reference printf contract ------------------------------------
     def common(self, msg: str, min_level: int = 1) -> None:

@@ -67,16 +67,26 @@ def test_bench_canonical_host_provenance_gate(monkeypatch, capsys, mesh8):
     monkeypatch.setenv("BENCH_NATIVE_RANKS", "0")
     key = ("radix", 12, "int32", 0)
 
+    from mpitest_tpu.utils import knobs
+
+    # bench.main() pins SORT_FALLBACK=0 / SORT_MAX_RETRIES=0 via
+    # os.environ.setdefault — correct for its normal subprocess life,
+    # but an IN-PROCESS call here would leak the pins into every later
+    # test in the suite (observed: the whole supervisor-ladder family
+    # failing "retry budget exhausted" in full runs while passing
+    # standalone).  scoped_env restores the pre-call state.
     monkeypatch.setitem(bench.CANONICAL_NATIVE_MKEYS, key,
                         {"mkeys": 1.0, "host": "someone-elses-box/64c"})
-    bench.main()
+    with knobs.scoped_env(SORT_FALLBACK=None, SORT_MAX_RETRIES=None):
+        bench.main()
     row = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert "vs_canonical_native" not in row
     assert "someone-elses-box/64c" in row["vs_canonical_native_skipped"]
 
     monkeypatch.setitem(bench.CANONICAL_NATIVE_MKEYS, key,
                         {"mkeys": 1.0, "host": host_fingerprint()})
-    bench.main()
+    with knobs.scoped_env(SORT_FALLBACK=None, SORT_MAX_RETRIES=None):
+        bench.main()
     row = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert row["vs_canonical_native"] > 0
     assert "vs_canonical_native_skipped" not in row

@@ -1,0 +1,405 @@
+"""Plan provenance (ISSUE 12): minting coverage across dispatch paths,
+predicted-vs-actual stamping under forced faults, regret math, the
+EXPLAIN renderers, and the input-distribution profiler invariants.
+
+Uses the session-wide virtual 8-device CPU mesh from conftest.py; the
+single-device cells build a 1-device mesh on the same backend.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+
+import numpy as np
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+from mpitest_tpu import report  # noqa: E402
+from mpitest_tpu.models import plan as plan_mod  # noqa: E402
+from mpitest_tpu.models.api import ingest_to_mesh, sort  # noqa: E402
+from mpitest_tpu.parallel.mesh import make_mesh  # noqa: E402
+from mpitest_tpu.utils import knobs  # noqa: E402
+from mpitest_tpu.utils.trace import Tracer  # noqa: E402
+
+
+def run_sort(x, algo="radix", mesh=None, **env):
+    """Sort under scoped knobs; returns (output, tracer) with the
+    finished plan on tracer.plan."""
+    tracer = Tracer()
+    with knobs.scoped_env(**env):
+        out = sort(x, algorithm=algo, mesh=mesh, tracer=tracer)
+    return out, tracer
+
+
+def the_plan(tracer) -> plan_mod.SortPlan:
+    p = tracer.plan
+    assert isinstance(p, plan_mod.SortPlan), "no plan minted"
+    assert p.finalized
+    return p
+
+
+# ------------------------------------------------- minting: every path
+
+def test_plan_minted_local_host(rng):
+    mesh = make_mesh(1)
+    x = rng.integers(-2**31, 2**31 - 1, size=4096, dtype=np.int32)
+    out, tr = run_sort(x, mesh=mesh)
+    assert np.array_equal(out, np.sort(x))
+    p = the_plan(tr)
+    assert p.ranks == 1
+    assert p.decisions["ladder"].chosen == "local"
+    assert p.decisions["engine"].chosen  # defaulted from counters
+    assert p.decisions["algo"].requested == "radix"
+    assert "sortedness" in p.profile
+
+
+def test_plan_minted_local_device(rng):
+    import jax
+
+    mesh = make_mesh(1)
+    x = jax.device_put(
+        rng.integers(-2**31, 2**31 - 1, size=4096, dtype=np.int32).astype(
+            np.int32),
+        mesh.devices.flat[0])
+    out, tr = run_sort(x, mesh=mesh)
+    p = the_plan(tr)
+    assert p.decisions["ladder"].chosen == "local"
+    # device input: no host sample — the profile may be empty, but the
+    # plan itself must still exist with the engine decision
+    assert p.decisions["engine"].chosen
+
+
+def test_plan_minted_local_pair_engine(rng, monkeypatch):
+    """The 64-bit adaptive pair path (forced bitonic on CPU runs the
+    Pallas interpreter).  Thresholds shrunk like test_pair_engine's
+    kernel cells — a full-size interpret-mode network costs ~1 min of
+    compile, which the timeout-bound tier-1 run cannot afford."""
+    from mpitest_tpu.ops import bitonic
+
+    monkeypatch.setattr(bitonic, "MIN_SORT_LOG2", 8)
+    monkeypatch.setattr(bitonic, "PAIR_BLOCK_LOG2", 9)
+    mesh = make_mesh(1)
+    x = rng.integers(-2**62, 2**62 - 1, size=600, dtype=np.int64)
+    out, tr = run_sort(x, mesh=mesh, SORT_LOCAL_ENGINE="bitonic")
+    assert np.array_equal(out, np.sort(x))
+    p = the_plan(tr)
+    assert p.decisions["ladder"].chosen == "local"
+    assert p.decisions["engine"].chosen
+
+
+def test_plan_minted_staged_ingest(rng):
+    mesh = make_mesh(1)
+    x = rng.integers(-2**31, 2**31 - 1, size=4096, dtype=np.int32)
+    staged = ingest_to_mesh(x, mesh=mesh)
+    out, tr = run_sort(staged, mesh=mesh)
+    assert np.array_equal(out, np.sort(x))
+    p = the_plan(tr)
+    assert p.decisions["ladder"].chosen == "local"
+
+
+@pytest.fixture(scope="module")
+def spmd_runs(mesh8):
+    """ONE radix + ONE sample distributed run, shared by the minting,
+    explain and schema assertions below (compiles are the cost here,
+    not the assertions — tier-1 is timeout-bound)."""
+    runs = {}
+    rng = np.random.default_rng(1234)
+    for algo in ("radix", "sample"):
+        x = rng.integers(-2**31, 2**31 - 1, size=1 << 14, dtype=np.int32)
+        out, tr = run_sort(x, algo=algo, mesh=mesh8)
+        assert np.array_equal(out, np.sort(x))
+        runs[algo] = tr
+    return runs
+
+
+@pytest.mark.parametrize("algo", ["radix", "sample"])
+def test_plan_minted_spmd(algo, spmd_runs):
+    tr = spmd_runs[algo]
+    p = the_plan(tr)
+    assert p.ranks == 8
+    d = p.decisions
+    assert d["algo"].chosen in ("radix", "sample")
+    assert d["cap"].trigger in ("exact", "estimate")
+    assert d["cap"].predicted["cap"] == d["cap"].chosen
+    assert d["cap"].actual["need"] is not None
+    assert d["cap"].actual["peer_recv_bytes"] > 0
+    assert "restage" in d and "engine" in d and "ladder" in d
+    # probe-riding profile fields landed
+    assert "skew_factor" in p.profile and "bin_entropy" in p.profile
+    # the sort.plan span was emitted and is registered
+    names = [s.name for s in tr.spans.spans]
+    assert "sort.plan" in names
+    from mpitest_tpu.utils import span_schema
+
+    assert span_schema.is_registered("sort.plan")
+
+
+def test_plan_off_knob(mesh8, rng):
+    x = rng.integers(-2**31, 2**31 - 1, size=4096, dtype=np.int32)
+    out, tr = run_sort(x, mesh=mesh8, SORT_PLAN="off")
+    assert np.array_equal(out, np.sort(x))
+    assert tr.plan is None
+    assert "sort.plan" not in [s.name for s in tr.spans.spans]
+    # fail-fast validation, like every knob
+    with knobs.scoped_env(SORT_PLAN="maybe"):
+        with pytest.raises(ValueError, match="SORT_PLAN"):
+            knobs.get("SORT_PLAN")
+
+
+# ---------------------------------------- predicted-vs-actual stamping
+
+def test_plan_overflow_regrows_stamped(mesh8, rng):
+    """cap_squeeze collapses the initial cap to the alignment floor —
+    the regrow loop must run and the supervisor must stamp the regrows
+    into the cap decision (regret >= 1 per discarded dispatch)."""
+    x = rng.integers(-2**31, 2**31 - 1, size=1 << 14, dtype=np.int32)
+    out, tr = run_sort(x, algo="radix", mesh=mesh8,
+                       SORT_FAULTS="cap_squeeze", SORT_NEGOTIATE="off")
+    assert np.array_equal(out, np.sort(x))
+    p = the_plan(tr)
+    cap = p.decisions["cap"]
+    assert cap.actual.get("regrows", 0) >= 1
+    assert cap.regret >= 1.0
+    assert p.decisions["cap"].trigger == "off"
+
+
+def test_plan_reroute_stamped(mesh8):
+    """Constant keys degenerate the sample splitters: the up-front
+    sniff reroutes to radix, recorded with its trigger and NO
+    late-reroute regret."""
+    x = np.zeros(1 << 14, dtype=np.int32)
+    out, tr = run_sort(x, algo="sample", mesh=mesh8)
+    assert np.array_equal(out, x)
+    p = the_plan(tr)
+    algo = p.decisions["algo"]
+    assert algo.requested == "sample"
+    assert algo.chosen == "radix"
+    assert algo.trigger in ("skew_sniff", "probe_estimate")
+    assert algo.regret == 0.0
+    # the plan's HEADLINE algo follows the reroute: digest, span head
+    # and the by-algo census must report what actually ran
+    assert p.algo == "radix"
+    assert p.digest()["algo"] == "radix"
+
+
+def test_plan_restage_stamped(mesh8):
+    """Sorted input on a mesh is arrangement-skewed: with a low restage
+    ratio the probe triggers the re-stage, and the plan carries the
+    predicted vs post-restage peer ratio."""
+    x = np.arange(1 << 15, dtype=np.int32)
+    out, tr = run_sort(x, algo="sample", mesh=mesh8,
+                       SORT_RESTAGE_RATIO="1.5")
+    assert np.array_equal(out, x)
+    p = the_plan(tr)
+    rs = p.decisions["restage"]
+    assert rs.chosen is True
+    assert rs.trigger in ("probe", "overflow")
+    if rs.trigger == "probe":
+        assert rs.actual["peer_ratio"] < rs.predicted["peer_ratio"]
+        assert rs.regret == 0.0
+
+
+def test_plan_ladder_rungs_stamped(mesh8, rng):
+    """Persistent dispatch faults walk the ladder to the host rung; the
+    descents are the ladder decision's regret."""
+    x = rng.integers(-2**31, 2**31 - 1, size=1 << 14, dtype=np.int32)
+    out, tr = run_sort(x, algo="radix", mesh=mesh8,
+                       SORT_FAULTS="dispatch_error:inf",
+                       SORT_MAX_RETRIES="0")
+    assert np.array_equal(out, np.sort(x))
+    p = the_plan(tr)
+    ladder = p.decisions["ladder"]
+    assert ladder.chosen == "host"
+    assert ladder.actual["rungs_descended"] == 2
+    assert ladder.regret >= 2.0
+
+
+def test_plan_negotiate_off_raises_cap_regret(rng):
+    """The acceptance comparison: same skewed input, negotiation on vs
+    off — off must export strictly more cap regret (the imbalance the
+    probe would have seen and the re-stage fixed)."""
+    mesh = make_mesh(2)
+    x = np.arange(1 << 15, dtype=np.int32)   # arrangement-skewed
+    with knobs.scoped_env(SORT_RESTAGE_RATIO="1.5"):
+        _, tr_on = run_sort(x, algo="sample", mesh=mesh)
+        _, tr_off = run_sort(x, algo="sample", mesh=mesh,
+                             SORT_NEGOTIATE="off")
+    on = the_plan(tr_on).decisions["cap"].regret
+    off = the_plan(tr_off).decisions["cap"].regret
+    assert off > on
+    assert tr_off.counters["plan_cap_regret"] == off
+
+
+# ----------------------------------------------------------- regret math
+
+def test_regret_relative():
+    assert plan_mod.relative_regret(100, 100) == 0.0
+    assert plan_mod.relative_regret(150, 100) == 0.5
+    assert plan_mod.relative_regret(0.5, 0.25) == 0.25  # floor at 1
+
+
+def test_regret_cap_rules():
+    p = plan_mod.SortPlan(algo="radix")
+    p.decide("cap", chosen=128, trigger="exact", cap=128, need=128,
+             fair=64)
+    p.actual("cap", need=128)
+    assert p.finalize() == 0.0
+    # regrows dominate
+    p.bump("cap", "regrows")
+    p.bump("cap", "regrows")
+    p.finalize()
+    assert p.decisions["cap"].regret == 2.0
+    # negotiation off: the need-above-fair imbalance is charged too
+    q = plan_mod.SortPlan(algo="sample")
+    q.decide("cap", chosen=200, trigger="off", cap=200, fair=100)
+    q.actual("cap", need=200)
+    q.finalize()
+    assert q.decisions["cap"].regret == pytest.approx(1.0)
+
+
+def test_regret_restage_and_ladder_rules():
+    p = plan_mod.SortPlan()
+    p.decide("restage", chosen=True, trigger="probe", peer_ratio=4.0)
+    p.actual("restage", peer_ratio=4.5)   # did not improve: wasted pass
+    p.decide("ladder", chosen="host")
+    p.bump("ladder", "rungs_descended")
+    p.bump("ladder", "dispatch_retries", 2)
+    p.finalize()
+    assert p.decisions["restage"].regret == 1.0
+    assert p.decisions["ladder"].regret == 3.0
+
+
+def test_regret_batch_rule():
+    p = plan_mod.SortPlan(algo="packed")
+    p.decide("batch", chosen=1024, trigger="window", members=3,
+             bucket=1024, waste=0.25)
+    p.actual("batch", waste=0.25, keys=768)
+    p.finalize()
+    assert p.decisions["batch"].regret == pytest.approx(0.25)
+
+
+def test_digest_shape():
+    p = plan_mod.SortPlan(algo="radix", n=100, dtype="int32", ranks=4)
+    p.decide("cap", chosen=256, trigger="exact", cap=256, need=250)
+    p.actual("cap", need=250)
+    p.decide("restage", chosen=False)
+    d = p.digest()
+    assert d["algo"] == "radix"
+    assert d["negotiated_cap"] == 256
+    assert d["restaged"] is False
+    assert d["regret"] >= 0.0
+    json.dumps(d)  # wire-safe
+
+
+# ------------------------------------------------------------- explain
+
+def test_explain_render_units(spmd_runs):
+    tr = spmd_runs["radix"]
+    rows = [dict(s.to_dict(), kind="span") for s in tr.spans.spans]
+    view = report.explain_view(rows)
+    assert view is not None
+    assert "plan algo=radix" in view
+    for needle in ("cap", "predicted:", "actual:", "regret=",
+                   "profile:"):
+        assert needle in view, view
+    # per-trace filter: nothing carries this id
+    assert report.explain_view(rows, "no-such-id") is None
+
+
+def test_explain_aggregate_table(spmd_runs):
+    rows = []
+    for tr in spmd_runs.values():
+        rows += [dict(s.to_dict(), kind="span") for s in tr.spans.spans]
+    view = report.explain_view(rows)
+    assert "aggregate regret over 2 plan(s)" in view
+    assert "decision" in view
+
+
+def test_explain_cli_modes(tmp_path, spmd_runs):
+    trace = tmp_path / "t.jsonl"
+    spmd_runs["radix"].spans.dump(str(trace))
+    # file via the --explain value, and via positional args
+    assert report.main(["--explain", str(trace)]) == 0
+    assert report.main(["--explain", str(trace), "--trace-id", "zz"]) == 1
+    # the stream must also pass the registered-schema gate
+    assert report.main(["--check", "--require-registered-spans",
+                        str(trace)]) == 0
+
+
+def test_baseline_flags_decision_drift():
+    """report.py --baseline compares the pinned plan digest too: same
+    throughput from flipped decisions is a DRIFT finding."""
+    current = {"metrics": {"radix_sort_mkeys_per_s_2e20_int32_8dev": {
+        "value": 100.0, "restaged": 0, "negotiated_cap": 4096,
+        "plan_regret": 0.1}}}
+    baseline = [{"kind": "bench",
+                 "metric": "radix_sort_mkeys_per_s_2e20_int32_8dev",
+                 "value": 100.0, "restaged": 1, "negotiated_cap": 1024,
+                 "plan_regret": 0.1}]
+    findings = report.flag_regressions(current, baseline, 0.9, "h")
+    drift = {f["metric"]: f for f in findings
+             if f["status"] == "DRIFT"}
+    assert any(m.endswith(".restaged") for m in drift)
+    assert any(m.endswith(".negotiated_cap") for m in drift)
+    # identical digests: no drift
+    same = [dict(baseline[0], restaged=0, negotiated_cap=4096)]
+    findings2 = report.flag_regressions(current, same, 0.9, "h")
+    assert not [f for f in findings2 if f["status"] == "DRIFT"]
+    # a CLEAN pin (regret 0.0) must still gate later regret — the
+    # absolute floor, not a pin>0 ratio band, drives the check
+    cur3 = {"metrics": {"m": {"value": 100.0, "plan_regret": 3.0}}}
+    base3 = [{"kind": "bench", "metric": "m", "value": 100.0,
+              "plan_regret": 0.0}]
+    findings3 = report.flag_regressions(cur3, base3, 0.9, "h")
+    assert any(f["status"] == "DRIFT" and f["metric"] == "m.plan_regret"
+               for f in findings3)
+    # ...while sub-floor jitter from a clean pin never flags
+    cur4 = {"metrics": {"m": {"value": 100.0, "plan_regret": 0.01}}}
+    findings4 = report.flag_regressions(cur4, base3, 0.9, "h")
+    assert not [f for f in findings4 if f["status"] == "DRIFT"]
+
+
+# ------------------------------------------------- profiler invariants
+
+def test_profiler_sorted_input():
+    prof = plan_mod.profile_host_array(np.arange(10_000, dtype=np.int32))
+    assert prof["sortedness"] == 1.0
+    assert prof["dup_ratio"] == 0.0
+    assert prof["run_len"] >= 1024 / 2
+
+
+def test_profiler_constant_input():
+    prof = plan_mod.profile_host_array(np.zeros(10_000, dtype=np.int32))
+    assert prof["dup_ratio"] == 1.0
+    assert prof["sortedness"] == 1.0
+
+
+def test_profiler_reverse_and_random():
+    rev = plan_mod.profile_host_array(
+        np.arange(10_000, 0, -1).astype(np.int32))
+    assert rev["sortedness"] <= 0.01
+    rnd = plan_mod.profile_host_array(
+        np.random.default_rng(0).integers(0, 2**31, 10_000).astype(
+            np.int32))
+    assert 0.3 < rnd["sortedness"] < 0.7
+    assert rnd["dup_ratio"] < 0.05
+
+
+def test_profiler_counts():
+    cnts = np.full((4, 4), 100)
+    prof = plan_mod.profile_from_counts(cnts, fair=100)
+    assert prof["skew_factor"] == 1.0
+    assert prof["bin_entropy"] == 1.0
+    hot = np.zeros((4, 4), dtype=int)
+    hot[:, 0] = 400   # everything to peer 0
+    prof2 = plan_mod.profile_from_counts(hot, fair=100)
+    assert prof2["skew_factor"] == 4.0
+    assert prof2["bin_entropy"] == 0.0
+
+
+def test_profiler_empty():
+    assert plan_mod.profile_host_array(np.empty(0, np.int32)) == {}

@@ -136,6 +136,12 @@ def test_span_bridge_maps_the_vocabulary():
     log.record("exchange_balance", 0.0, 0.0, recv_ratio=1.5,
                peer_ratio=2.0, negotiated_cap=256, worst_cap=2048,
                recv_bytes=[10, 20], send_bytes=[15, 15])
+    log.record("sort.plan", 0.0, 0.0, algo="radix", regret=1.25,
+               decisions={"cap": {"chosen": 256, "regret": 1.25},
+                          "algo": {"chosen": "radix",
+                                   "requested": "sample",
+                                   "trigger": "skew_sniff",
+                                   "regret": 0.0}})
     assert m.counter("sort_serve_requests_total").get(status="ok") == 1
     assert m.counter("sort_serve_requests_total").total() == 3
     # only the ok request is a latency sample
@@ -153,6 +159,13 @@ def test_span_bridge_maps_the_vocabulary():
     assert m.counter("sort_faults_total").get(site="exchange_drop") == 1
     assert m.gauge("sort_exchange_peer_ratio").get() == 2.0
     assert m.gauge("sort_exchange_rank_recv_bytes").get(rank="1") == 20
+    # plan provenance (ISSUE 12)
+    assert m.counter("sort_plans_total").get(algo="radix") == 1
+    assert m.gauge("sort_plan_regret").get() == 1.25
+    assert m.gauge("sort_plan_cap_regret").get() == 1.25
+    assert m.gauge("sort_plan_decision_regret").get(decision="cap") == 1.25
+    assert m.counter("sort_plan_reroutes_total").get(
+        trigger="skew_sniff") == 1
 
 
 def test_bridge_errors_never_escape_the_span_path():
@@ -494,6 +507,11 @@ def test_telemetry_http_endpoints(rng):
             st, body = get("/varz")
             vz = json.loads(body)
             assert st == 200 and "admission" in vz and "mesh" in vz
+            # rolling decision snapshot (ISSUE 12), fed from the ring
+            plans = vz["plans"]
+            assert plans["plans"] >= 1
+            assert "cap" in plans["decisions"]
+            assert plans["last"]["algo"] is not None
             st, body = get("/flightrecorder")
             assert st == 200
             rows = [json.loads(ln) for ln in body.decode().splitlines()

@@ -101,6 +101,32 @@ def test_sl004_metric_names_come_from_registry():
     assert lint_source(bad, "mpitest_tpu/utils/metrics_live.py") == []
 
 
+def test_sl005_plan_decisions_come_from_registry():
+    bad = "plan.decide('warp_speed', chosen=1)\n"
+    assert rules_of(lint_source(bad, "x.py")) == ["SL005"]
+    bad2 = "self.plan.actual('made_up', need=3)\n"
+    assert rules_of(lint_source(bad2, "x.py")) == ["SL005"]
+    nonlit = "plan.bump(name, 'regrows')\n"
+    assert rules_of(lint_source(nonlit, "x.py")) == ["SL005"]
+    good = ("plan.decide('cap', chosen=128, cap=128, need=100)\n"
+            "self.plan.bump('cap', 'regrows')\n"
+            "plan.actual('restage', peer_ratio=1.1)\n")
+    assert lint_source(good, "x.py") == []
+    # unrelated receivers never match (a dict named `state`, say)
+    unrelated = "state.decide('whatever')\n"
+    assert lint_source(unrelated, "x.py") == []
+    # the registry module itself is exempt
+    assert lint_source(bad, "mpitest_tpu/models/plan.py") == []
+
+
+def test_plan_registry_vocabulary():
+    from mpitest_tpu.models import plan as plan_mod
+
+    assert all(doc for doc in plan_mod.PLAN_DECISIONS.values())
+    assert {"algo", "cap", "restage", "engine", "passes", "ladder",
+            "batch"} == set(plan_mod.PLAN_DECISIONS)
+
+
 def test_metrics_registry_vocabulary():
     from mpitest_tpu.utils import metrics_live
 

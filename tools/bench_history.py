@@ -41,9 +41,22 @@ COLUMNS: tuple[tuple[str, str, str, bool], ...] = (
     ("cap_saving_pct", "cap saving", "%", True),
     ("serve_mkeys_per_s", "serve", "Mkeys/s", True),
     ("serve_p99_ms", "serve p99", "ms", False),
+    # plan provenance (ISSUE 12): rows record the run's decision regret
+    # beside its throughput, so the trajectory captures DECISIONS —
+    # rising regret is a planner/negotiation regression even when the
+    # throughput column still looks fine
+    ("plan_regret", "plan regret", "x", False),
 )
 
 _RUN_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+#: Absolute floor for LOWER-is-better columns when comparing against
+#: the best earlier round: a best of 0 (common for plan_regret — every
+#: prediction matched) would otherwise make ANY later nonzero value an
+#: infinite-ratio regression, failing the strict CI gate on meaningless
+#: near-zero jitter.  Values must exceed best-or-floor / threshold to
+#: flag.
+LOWER_BEST_FLOOR = 0.25
 
 
 def _json_lines(text: str) -> list[dict]:
@@ -88,8 +101,11 @@ def load_run(path: Path) -> dict[str, float]:
                 put("serve_p99_ms", obj.get("p99_ms"))
             elif name.endswith("_8dev"):
                 put("cap_saving_pct", obj.get("cap_saving_pct"))
+                put("plan_regret", obj.get("plan_regret"))
             else:
                 put("sort_row_mkeys_per_s", obj["value"])
+                if "plan_regret" not in vals:
+                    put("plan_regret", obj.get("plan_regret"))
     # derived: end-to-end ratio when a round recorded both throughputs
     # but not the ratio itself (pre-ISSUE-6 rounds)
     if "ingest_ratio" not in vals and \
@@ -136,10 +152,11 @@ def build_table(runs: list[tuple[int, Path]],
             cell = f"{v:g}"
             prev = best.get(key)
             if prev is not None:
+                floor = max(prev, LOWER_BEST_FLOOR)
                 regressed = (v < threshold * prev) if hib else \
-                    (v > prev / threshold)
+                    (v > floor / threshold)
                 if regressed:
-                    ratio = (v / prev) if hib else (prev / v)
+                    ratio = (v / prev) if hib else (floor / v)
                     cell += f" ⚠ ({ratio:.2f}x)"
                     flags.append(
                         f"r{rid:02d} {title}: {v:g} vs best {prev:g} "

@@ -279,6 +279,80 @@ register(Rule(
     _check_metric_name))
 
 
+# ---------------------------------------------------------------- SL005
+
+def _load_plan_schema() -> Any:
+    """models/plan.py by file path (stdlib-only at import by design,
+    like span_schema) — SL005 checks against the real PLAN_DECISIONS."""
+    import sys
+
+    path = REPO_ROOT / "mpitest_tpu" / "models" / "plan.py"
+    spec = importlib.util.spec_from_file_location("_sortlint_plan", path)
+    assert spec is not None and spec.loader is not None
+    mod = importlib.util.module_from_spec(spec)
+    # plan.py declares dataclasses, whose processing looks the module
+    # up in sys.modules — register before exec (span_schema/metrics
+    # carry none, so their loaders skip this)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_PLAN_MOD = _load_plan_schema()
+
+#: The module that IS the decision registry — the rule polices users.
+_PLAN_EXEMPT = ("mpitest_tpu/models/plan.py",)
+
+#: Receiver names that denote a SortPlan.  Attribute-shaped matching
+#: like SL003/SL004: ``<plan-ish>.decide/actual/bump("name", ...)`` —
+#: unrelated bases never match.
+_PLAN_BASES = ("plan", "sort_plan", "bplan", "splan")
+
+
+def _check_plan_decision(path: str, src: str,
+                         tree: ast.AST) -> list[Finding]:
+    if _ends(path, *_PLAN_EXEMPT):
+        return []
+    out = []
+    for node, _ in _walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not isinstance(f, ast.Attribute) or \
+                f.attr not in ("decide", "actual", "bump"):
+            continue
+        base = f.value
+        base_name = base.id if isinstance(base, ast.Name) else \
+            base.attr if isinstance(base, ast.Attribute) else ""
+        if base_name not in _PLAN_BASES or not node.args:
+            continue
+        first = node.args[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            name = first.value
+            if name not in _PLAN_MOD.PLAN_DECISIONS:
+                out.append(Finding(
+                    "SL005", path, node.lineno,
+                    f"plan decision {name!r} is not registered in "
+                    "models/plan.py PLAN_DECISIONS; register it there "
+                    "(report.py --explain and the /varz decision "
+                    "snapshot key on these names — unregistered "
+                    "decisions vanish from the provenance surfaces)"))
+        else:
+            out.append(Finding(
+                "SL005", path, node.lineno,
+                "non-literal plan decision name — the registered-"
+                "vocabulary check cannot see it; use a literal, or "
+                "suppress with a reason"))
+    return out
+
+
+register(Rule(
+    "SL005", "plan-decision-registry",
+    "literal plan decision names must come from models/plan.py "
+    "PLAN_DECISIONS",
+    _check_plan_decision))
+
+
 # ------------------------------------------------------- SL010 / SL011 / SL012
 
 def _check_lax_reduce(path: str, src: str, tree: ast.AST) -> list[Finding]:

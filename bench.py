@@ -191,6 +191,10 @@ def _measure_multichip(algo: str, dtype: np.dtype, log2n: int,
         "unit": "Mkeys/s",
         "devices": MULTICHIP_DEVICES,
         "platform": platform,
+        # ISSUE 13: the engine the timed exchange ran (the primary/8dev
+        # rows pin lax for trajectory comparability; the pallas smoke
+        # cell below carries the new engine's parity evidence).
+        "exchange_engine": c.get("exchange_engine", "lax"),
     }
     metrics = Metrics(config={"platform": platform, "algo": algo,
                               "log2n": log2n, "dtype": dtype.name,
@@ -218,6 +222,35 @@ def _measure_multichip(algo: str, dtype: np.dtype, log2n: int,
         metrics.record("plan_regret", row["plan_regret"], "x")
     if "plan_cap_regret" in c:
         row["plan_cap_regret"] = round(float(c["plan_cap_regret"]), 6)
+    # ISSUE 13: the pallas_interpret smoke cell — parity evidence for
+    # the second exchange engine, SCALE-GATED to a tiny fixed N so the
+    # interpreter never times (or delays) a measured row.  On a TPU
+    # backend the same knob value exercises the fused pack under the
+    # interpreter while the remote-DMA hop rides the lax transport
+    # (ops/exchange.py interpret contract).
+    try:
+        n_smoke = 1 << 12
+        xs = generate("uniform", n_smoke, dtype, seed=1)
+        out_lax = sort(xs, algorithm=algo, mesh=mesh, exchange_engine="lax")
+        out_pal = sort(xs, algorithm=algo, mesh=mesh,
+                       exchange_engine="pallas_interpret")
+        parity = bool(np.array_equal(out_lax, out_pal)
+                      and out_lax.tobytes() == out_pal.tobytes())
+        row["pallas_interpret_smoke"] = {
+            "n": n_smoke, "parity": parity, "engine": "pallas_interpret"}
+        metrics.record("exchange_pallas_smoke_parity", int(parity))
+        log(f"multichip: pallas_interpret smoke at 2^12 — "
+            f"{'bit-identical' if parity else 'PARITY FAILURE'}")
+        if not parity:
+            # zero BOTH surfaces: the sidecar must not keep a healthy
+            # throughput for a round whose row was zeroed for parity
+            row["value"] = 0.0
+            metrics.record("sort_mkeys_per_s_8dev", 0.0, "Mkeys/s")
+            log("multichip: CORRECTNESS FAILURE (engine parity) — "
+                "reporting value 0")
+    except Exception as e:  # noqa: BLE001 — smoke must not kill the row
+        log(f"multichip: pallas smoke skipped ({type(e).__name__}: {e})")
+        row["pallas_interpret_smoke"] = {"error": type(e).__name__}
     metrics.record_tracer(tracer)
     metrics.dump()
     return row
@@ -331,10 +364,12 @@ def multichip_main() -> None:
 
     if dtype.itemsize == 8:
         jax.config.update("jax_enable_x64", True)
-    # same supervisor pinning as the primary driver: degradation or
-    # retry sleeps must not silently rewrite a metric
+    # same supervisor + engine pinning as the primary driver:
+    # degradation, retry sleeps or an engine flip must not silently
+    # rewrite a metric (the pallas evidence is the smoke cell)
     os.environ.setdefault("SORT_FALLBACK", "0")
     os.environ.setdefault("SORT_MAX_RETRIES", "0")
+    os.environ.setdefault("SORT_EXCHANGE_ENGINE", "lax")
     platform = jax.devices()[0].platform
     if len(jax.devices()) < MULTICHIP_DEVICES:
         raise SystemExit(
@@ -476,6 +511,14 @@ def main() -> None:
     # reported below as verify_overhead_s.
     os.environ.setdefault("SORT_FALLBACK", "0")
     os.environ.setdefault("SORT_MAX_RETRIES", "0")
+    # ISSUE 13: the measured rows pin the lax exchange engine so the
+    # r01+ trajectory stays engine-comparable (auto would flip the
+    # primary row to pallas on the first TPU session); the pallas
+    # engine's evidence rides the scale-gated smoke cell in the
+    # multichip row + `bench/multichip_selftest.py`'s engine axis.
+    # Remove the pin deliberately (SORT_EXCHANGE_ENGINE=pallas) when a
+    # TPU round is ready to re-baseline the trajectory.
+    os.environ.setdefault("SORT_EXCHANGE_ENGINE", "lax")
 
     platform = jax.devices()[0].platform
     on_tpu = platform not in ("cpu",)
@@ -712,6 +755,7 @@ def main() -> None:
         "faults_injected": faults_injected,
         "verify_overhead_s": verify_s,
         "encode_engine": encode_engine,
+        "exchange_engine": tracer.counters.get("exchange_engine", "lax"),
         "tooling": tooling_state(),
     }
     if encode_gbs is not None:

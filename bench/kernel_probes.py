@@ -60,7 +60,7 @@ def main() -> None:
             for k in range(k_reps):
                 v = body(v, k)
             o_ref[0] = v
-        return pl.pallas_call(
+        return pl.pallas_call(  # sortlint: disable=SL013 -- on-chip pricing probe, not a production kernel; results feed BASELINE.md, never a sort
             kern,
             out_shape=jax.ShapeDtypeStruct((nblk, s_rows, lanes), jnp.int32),
             grid=(nblk,), in_specs=[spec], out_specs=spec,
@@ -116,7 +116,7 @@ def main() -> None:
             for k in range(k_reps):
                 hi, lo = body(hi, lo, k)
             ohi_ref[0], olo_ref[0] = hi, lo
-        return pl.pallas_call(
+        return pl.pallas_call(  # sortlint: disable=SL013 -- on-chip pricing probe, not a production kernel; results feed BASELINE.md, never a sort
             kern,
             out_shape=[jax.ShapeDtypeStruct((nblk, s_rows, lanes), jnp.int32)] * 2,
             grid=(nblk,), in_specs=[spec, spec], out_specs=[spec, spec],

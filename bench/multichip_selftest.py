@@ -80,15 +80,48 @@ def main() -> int:
         "duplicate_skew": rng.choice(
             np.asarray([3, 7, 7, 7, 42], np.int32), size=1 << 14),
     }
+    from mpitest_tpu.models import verify as vfy
+    from mpitest_tpu.ops.keys import codec_for
+
     for algo in ("radix", "sample"):
         for name, x in inputs.items():
-            out8 = sort(x, algorithm=algo, mesh=mesh8)
+            # one 8-device sort per engine per cell — sections 1 and 1b
+            # below compare these SAME outputs (the engine axis is pure
+            # byte/fingerprint comparison, no extra interpret sorts)
+            out8 = sort(x, algorithm=algo, mesh=mesh8,
+                        exchange_engine="lax")
             out1 = sort(x, algorithm=algo, mesh=mesh1)
             same = (np.array_equal(out8, out1)
                     and out8.tobytes() == out1.tobytes())
             cell(f"parity/{algo}/{name}", same,
                  "8-device output bit-identical to 1-device"
                  if same else "OUTPUT DIVERGED between mesh sizes")
+            # ISSUE 13: the 1-vs-8 parity cell re-run under the pallas
+            # exchange engine (interpret form on this CPU image — the
+            # fused pack + engine plumbing run for real, the remote-DMA
+            # hop rides the bit-identical lax transport).
+            out8p = sort(x, algorithm=algo, mesh=mesh8,
+                         exchange_engine="pallas_interpret")
+            same_p = (np.array_equal(out8p, out1)
+                      and out8p.tobytes() == out1.tobytes())
+            cell(f"parity/{algo}/{name}/pallas", same_p,
+                 "pallas-engine 8-device output bit-identical to 1-device"
+                 if same_p else "PALLAS ENGINE OUTPUT DIVERGED")
+
+            # ---- 1b. engine axis: lax vs pallas_interpret -----------
+            # Bit-identical output AND multiset fingerprint across the
+            # engine knob (ISSUE 13), on the outputs already computed.
+            same_e = (np.array_equal(out8, out8p)
+                      and out8.tobytes() == out8p.tobytes())
+            codec = codec_for(np.dtype(x.dtype))
+            fp_lax = vfy.fingerprint_host(codec.encode(out8))
+            fp_pal = vfy.fingerprint_host(codec.encode(out8p))
+            ok = same_e and fp_lax == fp_pal
+            cell(f"engine/{algo}/{name}", ok,
+                 "lax vs pallas_interpret bit-identical + fingerprints "
+                 "equal" if ok else
+                 f"ENGINE DIVERGENCE (bytes={same_e}, "
+                 f"fp={fp_lax == fp_pal})")
 
     # ---- 2+3. balance + negotiated capacity on skewed inputs --------
     skewed = inputs["sorted_skew"]

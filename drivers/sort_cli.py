@@ -134,6 +134,7 @@ def main(argv: list[str] | None = None) -> int:
             "SORT_DONATE", "SORT_NATIVE_ENCODE", "SORT_VERIFY",
             "SORT_MAX_RETRIES", "SORT_RETRY_BACKOFF", "SORT_FALLBACK",
             "SORT_FAULTS", "SORT_FAULTS_SEED", "SORT_LOCAL_ENGINE",
+            "SORT_EXCHANGE_ENGINE",
             "SORT_DEVICES", "SORT_NEGOTIATE", "SORT_RESTAGE",
             "SORT_RESTAGE_RATIO",
             # live-telemetry knobs (ISSUE 10): the span sampler runs in

@@ -92,7 +92,8 @@ def main(argv: list[str] | None = None) -> int:
             "SORT_ALGO", "SORT_DTYPE", "SORT_DEVICES", "SORT_RANKS",
             "SORT_VERIFY", "SORT_MAX_RETRIES", "SORT_RETRY_BACKOFF",
             "SORT_FALLBACK", "SORT_FAULTS", "SORT_FAULTS_SEED",
-            "SORT_LOCAL_ENGINE", "SORT_NEGOTIATE", "SORT_RESTAGE",
+            "SORT_LOCAL_ENGINE", "SORT_EXCHANGE_ENGINE",
+            "SORT_NEGOTIATE", "SORT_RESTAGE",
             "SORT_RESTAGE_RATIO", "SORT_NATIVE_ENCODE",
             # plan provenance (ISSUE 12): the decision record behind
             # the response header's plan digest and /varz snapshot

@@ -192,6 +192,37 @@ def _cap_align(pack: str) -> int:
     return CHUNK if pack.startswith("pallas") else 128
 
 
+def _resolve_exchange_engine(engine: str | None) -> str:
+    """Concrete exchange-engine impl (ISSUE 13): ``None`` reads the
+    ``SORT_EXCHANGE_ENGINE`` knob.  ``auto`` = the remote-DMA Pallas
+    engine on real TPU backends, the XLA collective elsewhere; a forced
+    ``pallas`` without a TPU runs the engine's interpreter form (same
+    convention as the bitonic local engine, :func:`_bitonic_impl`) —
+    the remote-copy hop itself then rides the bit-identical
+    ``lax.all_to_all``, see ``ops/exchange.py``."""
+    from mpitest_tpu.ops import exchange as xeng
+
+    v = engine if engine is not None else supervision.exchange_engine_knob()
+    if v == "auto":
+        return "pallas" if jax.default_backend() == "tpu" else "lax"
+    if v not in xeng.ENGINES:
+        raise ValueError(
+            f"unknown exchange engine {v!r}; use one of "
+            f"{('auto',) + xeng.ENGINES}")
+    if v == "pallas" and jax.default_backend() != "tpu":
+        return "pallas_interpret"
+    return v
+
+
+def _engine_pack(pack_impl: str, engine: str) -> tuple[str, int]:
+    """(effective pack impl, cap alignment) for one ladder rung: the
+    pallas exchange engine owns its pack (the fused multi-word kernel,
+    CHUNK-aligned caps); the lax engine keeps the resolved ``pack``."""
+    if engine.startswith("pallas"):
+        return engine, _cap_align(engine)
+    return pack_impl, _cap_align(pack_impl)
+
+
 def _passes_from_diffs(diffs: tuple[int, ...], digit_bits: int) -> int:
     """Number of LSD passes actually required, from per-word ``max ^ min``
     diffs (msw first) — the one canonical pass planner, shared by the host
@@ -601,7 +632,8 @@ def _compile_local(n_words: int,
 @lru_cache(maxsize=64)
 def _compile_radix(mesh: Mesh, n_words: int, n: int, digit_bits: int,
                    cap: int, passes: int, pack: str, donate: bool = False,
-                   fault_token: str = "") -> Callable[..., Any]:
+                   fault_token: str = "",
+                   exchange_engine: str = "lax") -> Callable[..., Any]:
     # fault_token: unique per armed exchange fault (mpitest_tpu.faults) —
     # a poisoned trace gets its own cache entry and can never be served
     # to a clean dispatch.  "" = the shared clean compile.
@@ -609,7 +641,8 @@ def _compile_radix(mesh: Mesh, n_words: int, n: int, digit_bits: int,
 
     def f(*words):
         out, max_cnt = radix_sort.radix_sort_spmd(
-            words, n_words, digit_bits, n_ranks, cap, passes, pack=pack
+            words, n_words, digit_bits, n_ranks, cap, passes, pack=pack,
+            exchange_engine=exchange_engine,
         )
         return out, max_cnt
 
@@ -621,7 +654,11 @@ def _compile_radix(mesh: Mesh, n_words: int, n: int, digit_bits: int,
             out_specs=((P(AXIS),) * n_words, P()),
             # pallas_call's internal ops mix varying/unvarying operands in
             # ways the vma checker rejects; out_specs are explicit here.
-            check_vma=(pack == "xla"),
+            # The engine conjunct matters only for DIRECT compiles (sort()
+            # forces pack to the engine's impl via _engine_pack, but e.g.
+            # radix_pass_states-style callers can pass pack="xla" with a
+            # pallas engine, whose transport still runs pallas kernels).
+            check_vma=(pack == "xla" and exchange_engine == "lax"),
         ),
         # Donation: the input word shards alias the output word shards
         # (same shape/dtype/sharding), so HBM holds ONE copy of the keys
@@ -636,13 +673,15 @@ def _compile_radix(mesh: Mesh, n_words: int, n: int, digit_bits: int,
 def _compile_sample(mesh: Mesh, n_words: int, n: int, cap: int,
                     oversample: int, pack: str, engine: str = "lax",
                     donate: bool = False,
-                    fault_token: str = "") -> Callable[..., Any]:
+                    fault_token: str = "",
+                    exchange_engine: str = "lax") -> Callable[..., Any]:
     # fault_token: see _compile_radix.
     n_ranks = mesh.devices.size
 
     def f(*words):
         out, count, max_cnt = sample_sort.sample_sort_spmd(
-            words, n_words, n_ranks, cap, oversample, pack=pack, engine=engine
+            words, n_words, n_ranks, cap, oversample, pack=pack,
+            engine=engine, exchange_engine=exchange_engine,
         )
         return out, count[None], max_cnt
 
@@ -654,7 +693,8 @@ def _compile_sample(mesh: Mesh, n_words: int, n: int, cap: int,
             out_specs=((P(AXIS),) * n_words, P(AXIS), P()),
             # pallas_call internals (exchange pack, bitonic engine) mix
             # varying/unvarying operands in ways the vma checker rejects.
-            check_vma=(pack == "xla" and engine == "lax"),
+            check_vma=(pack == "xla" and engine == "lax"
+                       and exchange_engine == "lax"),
         ),
         # see _compile_radix: input/output word aliasing under donation
         # ([P*(P*cap)] outputs differ in shape from [P*n] inputs, so XLA
@@ -1027,9 +1067,18 @@ def sort(
     tracer: Tracer | None = None,
     return_result: bool = False,
     pack: str | None = None,   # exchange pack impl; None = auto by backend
+    exchange_engine: str | None = None,  # None = SORT_EXCHANGE_ENGINE knob
 ) -> Any:
     """Sort integer keys on the mesh; returns a sorted numpy array
     (or the device-resident :class:`DistributedSortResult`).
+
+    ``exchange_engine`` (ISSUE 13) selects the inter-device exchange
+    path — ``lax`` (XLA collective) or ``pallas`` (remote-DMA kernel +
+    fused pass, ``ops/exchange.py``; ``pallas_interpret`` is its
+    interpreter form); ``None`` reads ``SORT_EXCHANGE_ENGINE`` (auto =
+    pallas on TPU backends).  A pallas failure degrades to the lax
+    engine on the supervisor ladder, fingerprint-verified and recorded
+    as a plan decision.
 
     ``x`` may be a host array, a device-resident ``jax.Array``, or a
     :class:`StagedIngest` from :func:`ingest_to_mesh` (pre-encoded,
@@ -1080,7 +1129,8 @@ def sort(
     ) as sp, faults.active(reg):
         try:
             out = _sort_impl(x, algorithm, mesh, digit_bits, cap_factor,
-                             oversample, tracer, return_result, pack, reg)
+                             oversample, tracer, return_result, pack, reg,
+                             exchange_engine)
             _finish_plan(tracer, plan)
         except supervision.SortFaultError as e:
             # ISSUE 10: a typed terminal error leaves an artifact — the
@@ -1106,6 +1156,7 @@ def _sort_impl(
     return_result: bool,
     pack: str | None,
     reg: "faults.FaultRegistry | None" = None,
+    exchange_engine: str | None = None,
 ) -> Any:
     """The sort() body (see the public wrapper's docstring — this layer
     assumes a validated algorithm and a live tracer/span log).
@@ -1183,6 +1234,15 @@ def _sort_impl(
         mesh = make_mesh()
     n_ranks = int(mesh.devices.size)
     n = max(1, math.ceil(N / n_ranks))
+
+    # ---- exchange engine (ISSUE 13): resolved once per run ----------
+    # The ladder may later degrade it (pallas → lax); _eng is the ONE
+    # mutable engine state every compile below reads.  Recorded in the
+    # counters even for exchange-free (1-device) runs so bench rows
+    # always carry the engine column.
+    eng0 = _resolve_exchange_engine(exchange_engine)
+    _eng = {"v": eng0}
+    tracer.counters["exchange_engine"] = eng0
 
     # ---- plan provenance (ISSUE 12): the run's decision record ------
     plan = tracer.plan if isinstance(tracer.plan, plan_mod.SortPlan) \
@@ -1409,9 +1469,15 @@ def _sort_impl(
                 words_np, mesh, n, pad)
 
     pack_impl = _resolve_pack(pack)
-    align = _cap_align(pack_impl)
+    # cap alignment follows the FIRST rung's engine: the pallas engine's
+    # fused pack needs CHUNK-aligned caps, and a CHUNK-aligned cap stays
+    # valid (just 128-aligned too) if the ladder later degrades to lax.
+    _, align = _engine_pack(pack_impl, eng0)
     if plan is not None:
-        plan.decide("engine", chosen=pack_impl)
+        # the pack that will actually run: the pallas exchange engine
+        # owns its fused pack regardless of the resolved pack impl
+        plan.decide("engine", chosen=_engine_pack(pack_impl, eng0)[0])
+        plan.decide("exchange_engine", chosen=eng0)
     # Donate the input word buffers to the SPMD program where the
     # backend profits (HBM aliasing) and the input can be rebuilt for
     # overflow retries (a donated buffer is dead after the dispatch).
@@ -1557,7 +1623,8 @@ def _sort_impl(
             send_bytes=[int(v) for v in send],
             recv_bytes=[int(v) for v in recv],
             recv_ratio=round(recv_ratio, 4),
-            peer_ratio=round(peer_ratio, 4), restaged=restaged)
+            peer_ratio=round(peer_ratio, 4), restaged=restaged,
+            exchange_engine=_eng["v"])
         tracer.counters["negotiated_cap"] = negotiated
         tracer.counters["worst_cap"] = n
         tracer.counters["exchange_balance_ratio"] = round(recv_ratio, 4)
@@ -1599,12 +1666,15 @@ def _sort_impl(
 
     def run_radix(cap0: int) -> DistributedSortResult:
         db, passes = radix_plan()
+        eng = _eng["v"]
+        eff_pack, eff_align = _engine_pack(pack_impl, eng)
+        tracer.counters["exchange_engine"] = eng
         if plan is not None:
             plan.decide("passes", chosen=passes, passes=passes,
                         digit_bits=db)
         if negotiate and passes > 0:
             cnts = _negotiate("radix", db)
-            need = _round_cap(int(cnts.max()), align)
+            need = _round_cap(int(cnts.max()), eff_align)
             # pass 1's need is EXACT; later passes depend on the post-
             # exchange arrangement, so multi-pass runs keep the
             # cap_factor floor and the regrow loop as backstop instead
@@ -1621,7 +1691,8 @@ def _sort_impl(
 
         def attempt(c: int):
             fn = _compile_radix(mesh, codec.n_words, n, db, c, passes,
-                                pack_impl, donate, sup.arm_exchange())
+                                eff_pack, donate, sup.arm_exchange(),
+                                exchange_engine=eng)
             with tracer.phase("sort"):
                 out, max_cnt = sup.dispatch(
                     "radix_spmd", fn, live_words, on_retry=mark_dead,
@@ -1641,7 +1712,7 @@ def _sort_impl(
             return out, max_cnt
 
         out, cap = sup.exchange_loop(
-            "radix", attempt, sup.squeeze_cap(cap0, align), align,
+            "radix", attempt, sup.squeeze_cap(cap0, eff_align), eff_align,
             _round_cap, on_overflow=mark_dead,
             re_stage=do_restage if restage_on else None)
         tracer.count("exchange_passes", passes)
@@ -1657,6 +1728,9 @@ def _sort_impl(
         return DistributedSortResult(out, N, dtype)
 
     def run_sample() -> DistributedSortResult:
+        eng = _eng["v"]
+        eff_pack, eff_align = _engine_pack(pack_impl, eng)
+        tracer.counters["exchange_engine"] = eng
         if words_np is not None:
             degenerate = _sample_skew_sniff(words_np, n_ranks)
         else:
@@ -1677,14 +1751,14 @@ def _sort_impl(
             if plan is not None:
                 plan.decide("algo", chosen="radix", trigger="skew_sniff")
             return run_radix(skew_cap)
-        cap_limit = _round_cap(SAMPLE_CAP_LIMIT_FACTOR * fair, align)
+        cap_limit = _round_cap(SAMPLE_CAP_LIMIT_FACTOR * fair, eff_align)
         cap_start = base_cap
         if negotiate:
             cnts = _negotiate("sample")
             # the sample probe is an ESTIMATE (sampled splitters) —
             # margin on top, and the regrow loop stays as backstop
             need = _round_cap(
-                int(float(cnts.max()) * SAMPLE_NEG_MARGIN) + 1, align)
+                int(float(cnts.max()) * SAMPLE_NEG_MARGIN) + 1, eff_align)
             if need > cap_limit:
                 # the estimate already busts the O(n) recv bound: route
                 # to radix NOW instead of paying a doomed full exchange
@@ -1716,8 +1790,9 @@ def _sort_impl(
 
         def attempt(c: int):
             fn = _compile_sample(mesh, codec.n_words, n, c, oversample,
-                                 pack_impl, spmd_engine, donate,
-                                 sup.arm_exchange())
+                                 eff_pack, spmd_engine, donate,
+                                 sup.arm_exchange(),
+                                 exchange_engine=eng)
             with tracer.phase("sort"):
                 out, counts, max_cnt = sup.dispatch(
                     "sample_spmd", fn, live_words, on_retry=mark_dead,
@@ -1733,8 +1808,9 @@ def _sort_impl(
 
         try:
             (out, counts), cap = sup.exchange_loop(
-                "sample", attempt, sup.squeeze_cap(cap_start, align), align,
-                _round_cap, cap_limit=cap_limit, on_overflow=mark_dead,
+                "sample", attempt, sup.squeeze_cap(cap_start, eff_align),
+                eff_align, _round_cap, cap_limit=cap_limit,
+                on_overflow=mark_dead,
                 re_stage=do_restage if restage_on else None)
         except ExchangeCapExceeded as e:
             tracer.verbose(
@@ -1788,24 +1864,45 @@ def _sort_impl(
                     "(input changed between ingest and fallback?)")
         return sorted_w
 
-    # ---- degradation ladder: requested algorithm -> the other one ->
-    # host lexsort.  Each rung gets one verification retry (a transient
-    # corruption re-dispatches clean); persistent dispatch failure or
-    # repeated verification failure moves down.  The ladder ends in a
-    # VERIFIED result or a typed error — never a silent wrong answer.
-    levels = [algorithm]
+    # ---- degradation ladder: pallas exchange engine -> lax engine
+    # (same algorithm, ISSUE 13), then requested algorithm -> the other
+    # one -> host lexsort.  Each rung gets one verification retry (a
+    # transient corruption re-dispatches clean); persistent dispatch
+    # failure or repeated verification failure moves down.  The ladder
+    # ends in a VERIFIED result or a typed error — never a silent wrong
+    # answer.
+    rungs: list[tuple[str, str]] = [(algorithm, eng0)]
     if supervision.fallback_enabled():
-        levels.append("sample" if algorithm == "radix" else "radix")
-        levels.append("host")
+        if eng0 != "lax":
+            # the engine rung: a broken pallas kernel must not cost the
+            # requested ALGORITHM — re-run it on the XLA collective
+            rungs.append((algorithm, "lax"))
+        rungs.append(("sample" if algorithm == "radix" else "radix", "lax"))
+        rungs.append(("host", "lax"))
     if plan is not None:
-        plan.decide("ladder", chosen=levels[0])
+        plan.decide("ladder", chosen=rungs[0][0])
 
     res = None
     host_words = None
     last_err: Exception | None = None
-    level = levels[0]
-    for level in levels:
-        if level != levels[0]:
+    #: why the previous rung ended: "dispatch" (supervised dispatch /
+    #: device error) vs "verify" (fingerprint/sortedness failures) —
+    #: the engine-degrade decision must blame the ACTUAL cause, not
+    #: stamp every descent off a pallas rung as a kernel fault.
+    last_fail = "dispatch"
+    level = rungs[0][0]
+    for level, rung_eng in rungs:
+        if rung_eng != _eng["v"]:
+            tracer.verbose(
+                f"degrading exchange engine {_eng['v']} -> {rung_eng}")
+            tracer.count("exchange_engine_degraded", 1)
+            _eng["v"] = rung_eng
+            if plan is not None:
+                plan.decide(
+                    "exchange_engine", chosen=rung_eng,
+                    trigger=("pallas_fault" if last_fail == "dispatch"
+                             else "verify_failure"))
+        if level != rungs[0][0]:
             tracer.verbose(f"degrading to the {level} path")
             if plan is not None:
                 plan.decide("ladder", chosen=level)
@@ -1823,6 +1920,7 @@ def _sort_impl(
                 ok = not verify_on or _check_result(cand, input_fp)
             except SortRetryExhausted as e:
                 last_err = e
+                last_fail = "dispatch"
                 tracer.verbose(f"{level} path failed persistently: {e}")
                 break
             except jax.errors.JaxRuntimeError as e:
@@ -1836,6 +1934,7 @@ def _sort_impl(
                     f"{level} path failed outside the sort dispatch: "
                     f"{e}")
                 last_err.__cause__ = e
+                last_fail = "dispatch"
                 tracer.count("sort_retries", 1)
                 tracer.verbose(f"{level} path device failure: "
                                f"{type(e).__name__}; degrading")
@@ -1845,6 +1944,7 @@ def _sort_impl(
                 done = True
                 break
             tracer.count("verify_failures", 1)
+            last_fail = "verify"
             force_restage()  # the input words themselves are suspect
         if done:
             break
@@ -1876,7 +1976,7 @@ def _sort_impl(
                            "fallback result; returning host-backed words")
             return DistributedSortResult(host_words, N, dtype)
 
-    if level != levels[0]:
+    if level != rungs[0][0]:
         tracer.counters["degraded_to"] = level
     if return_result:
         return res

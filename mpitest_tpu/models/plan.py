@@ -56,6 +56,12 @@ PLAN_DECISIONS: dict[str, str] = {
                 "predicted vs post-restage peer ratio"),
     "engine": ("exchange-pack and local-sort engine selection "
                "(xla/pallas pack, lax/bitonic local)"),
+    "exchange_engine": ("inter-device exchange engine (ISSUE 13): "
+                        "lax collective vs pallas remote-DMA + fused "
+                        "pass; a degrade to lax (trigger=pallas_fault "
+                        "for dispatch faults, verify_failure for "
+                        "failed verification) is this decision's "
+                        "regret"),
     "passes": ("radix pass plan: digit width + pass count from the "
                "word-diff planner vs passes actually dispatched"),
     "ladder": ("fallback-ladder rung the result came from; descents "
@@ -253,6 +259,13 @@ class SortPlan:
         if d.name == "engine":
             # an engine whose residual fallback ran paid both engines
             return float(a.get("fallbacks", 0) or 0)
+        if d.name == "exchange_engine":
+            # either degrade cause paid every dispatch up to the switch
+            # before the lax rung re-ran the whole algorithm; the
+            # trigger names the cause class (kernel fault vs failed
+            # verification, which may equally implicate the data)
+            return 1.0 if d.trigger in ("pallas_fault",
+                                        "verify_failure") else 0.0
         return 0.0
 
     def finalize(self) -> float:
@@ -304,6 +317,9 @@ class SortPlan:
             out["cap_regret"] = cap.regret
         if restage is not None:
             out["restaged"] = bool(restage.chosen)
+        xeng = self.decisions.get("exchange_engine")
+        if xeng is not None:
+            out["exchange_engine"] = _scalar(xeng.chosen)
         batch = self.decisions.get("batch")
         if batch is not None:
             out["bucket"] = _scalar(batch.chosen)

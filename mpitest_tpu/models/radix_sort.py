@@ -182,6 +182,7 @@ def radix_sort_spmd(
     passes: int | None = None,
     axis: str = AXIS,
     pack: str = "xla",
+    exchange_engine: str = "lax",
 ) -> tuple[Words, jax.Array]:
     """Full multi-pass radix sort of the shard. SPMD; call under shard_map.
 
@@ -190,18 +191,41 @@ def radix_sort_spmd(
     optimization, ``mpi_radix_sort.c:100``, done right).  Passes run from
     the least-significant digit of the least-significant word upward.
 
+    ``exchange_engine`` (ISSUE 13) selects the per-pass exchange path:
+
+    * ``"lax"`` — the original pass: after the fused sort, the n-element
+      ``dest`` plane materializes (piecewise_fill + iota), segments come
+      from ``searchsorted(dest)``, and the pack/transport ride
+      :func:`collectives.ragged_all_to_all` with the ``pack`` impl.
+    * ``"pallas"`` / ``"pallas_interpret"`` — the fused pass: segments
+      come straight from the histogram's clip-arithmetic
+      (:func:`collectives.block_send_segments` — histogram → exclusive
+      scan → segments is [bins]-sized math, the dest plane and its two
+      extra n-element HBM round-trips never exist), all key words pack
+      in ONE fused kernel sweep, the transport is the remote-DMA kernel
+      (``ops/exchange.py``), and the **overlap loop** double-buffers:
+      pass k+1's lane-slot (scatter) plane is computed via the
+      ``pre_exchange`` hook while pass k's bucket sends are still in
+      flight — it depends only on the tiny count exchange + replicated
+      H state, never on the payload DMAs.  Both engines are
+      bit-identical by construction (same sorts, same segment values,
+      same fill contract); the parity gates pin it.
+
     Returns ``(sorted_words, max_send_cnt_over_passes)`` — the second value
     > cap means an exchange overflowed and the host must retry with at
     least that cap (an overflowed pass corrupts later passes, so the
     reported value is a lower bound; the host loop grows the cap
     monotonically until no pass overflows).
     """
+    from mpitest_tpu.ops import exchange as xeng
+
     n = words[0].shape[0]
     n_bins = 1 << digit_bits
     my = lax.axis_index(axis)
     per_word = (32 + digit_bits - 1) // digit_bits
     total = per_word * n_words if passes is None else passes
     max_cnt = jnp.zeros((), jnp.int32)
+    fused = xeng.is_pallas(exchange_engine)
 
     plan = []  # (word_idx, shift), lsw first
     for w_idx in range(n_words - 1, -1, -1):
@@ -215,7 +239,8 @@ def radix_sort_spmd(
     # recv-buffer state between exchanges; None before the first pass.
     recv: Words | None = None
     recv_cnt = None
-    prev = None  # (H, digit_base, rank_base) of the pending exchange
+    prev = None  # lax engine: (H, digit_base, rank_base) of the pending exchange
+    slot_carry = None  # pallas engine: the overlapped lane-slot plane
 
     for k, (w_idx, shift) in enumerate(plan):
         with _pass_span(k + 1, w_idx, shift, digit_bits, n, cap):
@@ -230,7 +255,11 @@ def radix_sort_spmd(
                 # Fused pass: merge the pending exchange buffer AND group by
                 # the new digit with ONE sort keyed on (digit, slot) — the
                 # pair is unique per valid lane, so no stability needed.
-                slot = _lane_slots(recv_cnt, *prev, n, cap, axis)
+                # Under the pallas engine the slot plane was already
+                # computed while the previous exchange's DMAs were in
+                # flight (the pre_exchange hook below).
+                slot = slot_carry if fused else \
+                    _lane_slots(recv_cnt, *prev, n, cap, axis)
                 d = kernels.digit_at(recv[w_idx], shift, digit_bits)
                 c = lax.iota(jnp.int32, cap)[None, :]
                 d = jnp.where(c < recv_cnt[:, None], d, n_bins)
@@ -249,18 +278,39 @@ def radix_sort_spmd(
             digit_base = coll.exclusive_cumsum(tot)
             base = digit_base + rank_base[my]      # [bins] my global run starts
 
-            # dest[j] = base[sd[j]] + (j - lo[sd[j]]) — gather-free step fn.
-            dest = kernels.piecewise_fill(lo_local, base - lo_local, n) + lax.iota(jnp.int32, n)
-            send_start, send_cnt = _send_segments(dest, n, n_ranks)
+            if fused:
+                # Fused pass (ISSUE 13): segments from [bins]-sized clip
+                # arithmetic — no n-element dest plane — and the next
+                # pass's scatter half precomputed during the DMA window.
+                send_start, send_cnt = coll.block_send_segments(
+                    h, base, n, n_ranks)
 
-            recv, recv_cnt, mc = coll.ragged_all_to_all(
-                sorted_words, send_start, send_cnt, cap, n_ranks, axis, pack=pack
-            )
+                def _pre(rc: jax.Array, H: jax.Array = H,
+                         db: jax.Array = digit_base,
+                         rb: jax.Array = rank_base) -> jax.Array:
+                    return _lane_slots(rc, H, db, rb, n, cap, axis)
+
+                recv, recv_cnt, mc, slot_carry = coll.ragged_all_to_all(
+                    sorted_words, send_start, send_cnt, cap, n_ranks,
+                    axis, pack=pack, engine=exchange_engine,
+                    pre_exchange=_pre,
+                )
+            else:
+                # dest[j] = base[sd[j]] + (j - lo[sd[j]]) — gather-free
+                # step fn.
+                dest = kernels.piecewise_fill(
+                    lo_local, base - lo_local, n) + lax.iota(jnp.int32, n)
+                send_start, send_cnt = _send_segments(dest, n, n_ranks)
+
+                recv, recv_cnt, mc = coll.ragged_all_to_all(
+                    sorted_words, send_start, send_cnt, cap, n_ranks,
+                    axis, pack=pack,
+                )
+                prev = (H, digit_base, rank_base)
             max_cnt = jnp.maximum(max_cnt, mc)
-            prev = (H, digit_base, rank_base)
 
     # Materialize the last pass's pending merge: one 1-key sort on slot.
-    slot = _lane_slots(recv_cnt, *prev, n, cap, axis)
+    slot = slot_carry if fused else _lane_slots(recv_cnt, *prev, n, cap, axis)
     flat = lax.sort(
         [slot.reshape(-1)] + [r.reshape(-1) for r in recv],
         num_keys=1, is_stable=False,

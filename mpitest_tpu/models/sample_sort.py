@@ -121,6 +121,7 @@ def sample_sort_spmd(
     axis: str = AXIS,
     pack: str = "xla",
     engine: str = "lax",
+    exchange_engine: str = "lax",
 ) -> tuple[Words, jax.Array, jax.Array]:
     """Full sample sort of the shard. SPMD; call under shard_map.
 
@@ -133,6 +134,14 @@ def sample_sort_spmd(
     = the Pallas engine of ``ops/bitonic.py`` (one-word keys), ``"lax"``
     = the fused ``lax.sort``.  The tiny splitter-sample sort always uses
     ``lax.sort``.
+
+    ``exchange_engine`` selects the one splitter-repartition exchange's
+    transport (ISSUE 13): ``"pallas"``/``"pallas_interpret"`` route the
+    negotiated per-peer buckets through the fused pack + remote-DMA
+    engine (``ops/exchange.py``); ``"lax"`` keeps the XLA collective.
+    Output is bit-identical either way (the sentinel-fill contract is
+    the same); sample sort has a single exchange, so the multi-pass
+    overlap loop is radix-only.
     """
     sorted_words = kernels.local_sort(words, engine=engine)
     splitters = select_splitters(sorted_words, n_ranks, oversample, axis)
@@ -148,7 +157,7 @@ def sample_sort_spmd(
     sentinel = (keys.MAX_WORD,) * n_words
     recv, recv_cnt, max_cnt = coll.ragged_all_to_all(
         sorted_words, send_start, send_cnt, cap, n_ranks, axis,
-        fill=sentinel, pack=pack,
+        fill=sentinel, pack=pack, engine=exchange_engine,
     )
     # Invalid lanes are max-sentinel filled → they sort to the tail; the
     # first `count` slots after sorting are exactly the valid multiset

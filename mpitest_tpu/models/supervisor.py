@@ -22,10 +22,13 @@ The supervisor centralizes all of it:
   :class:`ExchangeCapExceeded` when the need crosses the caller's O(n)
   bound (the sample→radix skew reroute keeps its policy in api.py; the
   mechanics live here, once).
-* **Degradation ladder** (driven by ``_sort_impl``): requested
-  algorithm → the other algorithm → host ``np.lexsort`` — taken only on
-  persistent dispatch failure or repeated verification failure, and
-  every rung's result still faces the same fingerprint verification.
+* **Degradation ladder** (driven by ``_sort_impl``): exchange engine
+  pallas → lax (ISSUE 13: a Pallas kernel failure re-runs the SAME
+  algorithm on the XLA collective before anything else moves), then
+  requested algorithm → the other algorithm → host ``np.lexsort`` —
+  taken only on persistent dispatch failure or repeated verification
+  failure, and every rung's result still faces the same fingerprint
+  verification.
   The ladder ends in a *verified* result or a typed error
   (:class:`SortIntegrityError` / :class:`SortRetryExhausted`), never a
   silent wrong answer.  ``SORT_FALLBACK=0`` pins the requested
@@ -88,6 +91,15 @@ def retry_backoff() -> float:
 def fallback_enabled() -> bool:
     """``SORT_FALLBACK`` (default on): the degradation ladder switch."""
     return knobs.get("SORT_FALLBACK")
+
+
+def exchange_engine_knob() -> str:
+    """``SORT_EXCHANGE_ENGINE`` (default auto): the exchange engine the
+    ladder's first rung runs — resolution to a concrete impl (auto →
+    pallas on TPU backends, lax elsewhere) lives in ``models/api.py``,
+    which knows the backend; the pallas → lax rung below it is this
+    module's ladder contract."""
+    return knobs.get("SORT_EXCHANGE_ENGINE")
 
 
 def verify_enabled() -> bool:

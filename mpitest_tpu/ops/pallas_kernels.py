@@ -46,6 +46,45 @@ ROWS = 8                    # (8, 128) = one int32 tile
 CHUNK = ROWS * LANES        # 1024 elements = 4 KiB per DMA
 
 
+def chunk_geometry(start, cnt, i, n: int):
+    """The (8, 128)/CHUNK pack-chunk geometry, shared by this module's
+    ``_pack_kernel`` and the fused multi-word pack of ``ops/exchange.py``
+    (ISSUE 13) — ONE home for the addressing invariants, so a fix to
+    the window math can never leave one engine's copy stale.
+
+    For output chunk ``i`` of a segment at ``start`` with ``cnt`` valid
+    elements in an ``n``-element (LANES-padded) buffer, returns
+    ``(arow, shift, valid)``:
+
+      * ``arow`` — the ROWS-aligned source row to DMA a 2-chunk window
+        from (clamped so beyond-count chunks never read past the padded
+        buffer);
+      * ``shift(x)`` — the in-register misaligned copy: shifts the
+        ``[2*ROWS, LANES]`` window left by ``base - arow*LANES``
+        elements (= r row rolls + a lane roll + select) and returns the
+        ``[ROWS, LANES]`` chunk plane;
+      * ``valid`` — the ``[ROWS, LANES]`` in-segment mask (beyond
+        ``cnt``, callers write their fill word).
+    """
+    base = jnp.minimum(start + i * CHUNK, n)
+    arow = pl.multiple_of(((base // LANES) // ROWS) * ROWS, ROWS)
+    sh = base - arow * LANES
+    r, l = sh // LANES, sh % LANES
+    lane = jax.lax.broadcasted_iota(jnp.int32, (2 * ROWS, LANES), 1)
+    sel = lane < LANES - l
+
+    def shift(x):
+        a = pltpu.roll(x, -r, 0)
+        b = pltpu.roll(x, -(r + 1), 0)
+        return jnp.where(sel, pltpu.roll(a, -l, 1),
+                         pltpu.roll(b, -l, 1))[:ROWS, :]
+
+    elem = (jax.lax.broadcasted_iota(jnp.int32, (ROWS, LANES), 0) * LANES
+            + jax.lax.broadcasted_iota(jnp.int32, (ROWS, LANES), 1))
+    valid = elem < (cnt - i * CHUNK)
+    return arow, shift, valid
+
+
 def _pack_kernel(n: int, fill: int, starts_ref, cnts_ref, data_ref,
                  out_ref, scratch, sem):
     """Grid (P, cap//CHUNK): instance (p, i) produces out chunk i of
@@ -53,11 +92,7 @@ def _pack_kernel(n: int, fill: int, starts_ref, cnts_ref, data_ref,
     the fill word beyond ``cnts[p]``."""
     p = pl.program_id(0)
     i = pl.program_id(1)
-    start = starts_ref[p]
-    cnt = cnts_ref[p]
-    # Clamp so beyond-count chunks never DMA past the padded data buffer.
-    base = jnp.minimum(start + i * CHUNK, n)
-    arow = pl.multiple_of(((base // LANES) // ROWS) * ROWS, ROWS)
+    arow, shift, valid = chunk_geometry(starts_ref[p], cnts_ref[p], i, n)
 
     dma = pltpu.make_async_copy(
         data_ref.at[pl.ds(arow, 2 * ROWS), :], scratch, sem
@@ -65,22 +100,7 @@ def _pack_kernel(n: int, fill: int, starts_ref, cnts_ref, data_ref,
     dma.start()
     dma.wait()
 
-    # Misaligned copy in-register: shift the 2-chunk window left by
-    # (base - arow*LANES) elements = r rows + l lanes.
-    sh = base - arow * LANES
-    r, l = sh // LANES, sh % LANES
-    x = scratch[...]                              # [2*ROWS, LANES]
-    a = pltpu.roll(x, -r, 0)
-    b = pltpu.roll(x, -(r + 1), 0)
-    la = pltpu.roll(a, -l, 1)
-    lb = pltpu.roll(b, -l, 1)
-    lane = jax.lax.broadcasted_iota(jnp.int32, (2 * ROWS, LANES), 1)
-    y = jnp.where(lane < LANES - l, la, lb)[:ROWS, :]
-
-    elem = (jax.lax.broadcasted_iota(jnp.int32, (ROWS, LANES), 0) * LANES
-            + jax.lax.broadcasted_iota(jnp.int32, (ROWS, LANES), 1))
-    valid = elem < (cnt - i * CHUNK)
-    out_ref[0, 0] = jnp.where(valid, y, jnp.uint32(fill))
+    out_ref[0, 0] = jnp.where(valid, shift(scratch[...]), jnp.uint32(fill))
 
 
 @functools.partial(

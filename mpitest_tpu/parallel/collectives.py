@@ -19,6 +19,8 @@ host can retry with the exact required cap (see models/api.py).
 
 from __future__ import annotations
 
+from typing import Any, Callable
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -113,6 +115,30 @@ def block_send_counts(H: jax.Array, n: int, axis: str = AXIS) -> jax.Array:
     return (cum[1:] - cum[:-1]).astype(jnp.int32)
 
 
+def block_send_segments(h: jax.Array, base: jax.Array, n: int,
+                        n_ranks: int) -> tuple[jax.Array, jax.Array]:
+    """Contiguous per-destination send segments of MY digit-sorted
+    shard, straight from the histogram + its global bases — the fused
+    pallas-pass form of :func:`radix_sort._send_segments` (ISSUE 13).
+
+    Under the dest = exact-global-position contract, my keys of digit
+    ``d`` occupy global positions ``[base[d], base[d] + h[d])`` and my
+    shard is dest-monotone, so the number of my keys landing before
+    block boundary ``s·n`` is ``cum[s] = Σ_d clip(s·n − base[d], 0,
+    h[d])`` — the same clipped-interval sum as :func:`block_send_counts`
+    but anchored at MY ``base`` (= ``digit_base + rank_base[me]``).
+    ``send_start[p] = cum[p]`` equals the lax engine's
+    ``searchsorted(dest, p·n)`` bit for bit, with **no n-element dest
+    array ever materialized**: the histogram → exclusive scan → segment
+    chain is [bins]-sized arithmetic, and the pack kernel reads the key
+    planes directly.  Returns ``(send_start, send_cnt)``, both int32[P].
+    """
+    bounds = lax.iota(jnp.int32, n_ranks + 1) * n
+    cum = jnp.clip(bounds[:, None] - base[None, :], 0,
+                   h[None, :]).sum(axis=1).astype(jnp.int32)
+    return cum[:-1], cum[1:] - cum[:-1]
+
+
 def exscan_counts(h: jax.Array, axis: str = AXIS) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Global exclusive scan of per-rank count vectors.
 
@@ -137,7 +163,9 @@ def ragged_all_to_all(
     axis: str = AXIS,
     fill: tuple[int, ...] | None = None,  # per-array fill word for invalid lanes
     pack: str = "xla",      # "xla" | "pallas" | "pallas_interpret"
-) -> tuple[Words, jax.Array, jax.Array]:
+    engine: str = "lax",    # "lax" | "pallas" | "pallas_interpret" (ISSUE 13)
+    pre_exchange: "Callable[[jax.Array], Any] | None" = None,
+) -> "tuple[Words, jax.Array, jax.Array] | tuple[Words, jax.Array, jax.Array, Any]":
     """``MPI_Alltoallv`` for contiguous ragged segments, on static shapes.
 
     Each local array is logically partitioned into P contiguous segments
@@ -147,7 +175,20 @@ def ragged_all_to_all(
     the exchange), so one monotone scatter spreads the data into the
     ``[P, cap]`` send matrix without any serial packing loop.
 
-    Returns ``(recv_arrays, recv_cnt, max_send_cnt)``:
+    ``engine`` selects the exchange transport (ISSUE 13): ``"lax"`` is
+    the XLA collective with the per-array ``pack`` impl; ``"pallas"`` /
+    ``"pallas_interpret"`` route through :mod:`mpitest_tpu.ops.exchange`
+    — ONE fused multi-word pack kernel plus the remote-DMA all-to-all
+    (``lax.all_to_all`` bit-identically under the interpreter, which
+    cannot simulate cross-device DMA).  ``pre_exchange(recv_cnt)`` is
+    the compute/DMA overlap hook: it runs between the tiny count
+    exchange and the payload transport, so work that depends only on
+    the counts and replicated state (the next radix pass's lane-slot
+    plane) carries **no data dependence on the payload DMAs** and the
+    scheduler is free to run it while the buckets are in flight; its
+    result is returned as a fourth element.
+
+    Returns ``(recv_arrays, recv_cnt, max_send_cnt[, pre_result])``:
       * ``recv_arrays[k]``: [P, cap] — lane (s, c) holds element c of the
         segment rank s sent to me (valid iff ``c < recv_cnt[s]``);
       * ``recv_cnt``: int32[P] — the explicit count exchange that replaces
@@ -155,11 +196,18 @@ def ragged_all_to_all(
       * ``max_send_cnt``: int32 scalar, globally reduced — ``> cap`` means
         the exchange overflowed and lanes were dropped; the caller retries
         with ``cap = max_send_cnt`` (exact, since the program is
-        deterministic).
+        deterministic);
+      * ``pre_result``: only when ``pre_exchange`` was given.
     """
+    from mpitest_tpu.ops import exchange as xeng
     from mpitest_tpu.ops import kernels
 
     n = arrays[0].shape[0]
+    use_pallas = xeng.is_pallas(engine)
+    interp = engine == "pallas_interpret"
+    if use_pallas:
+        # the engine owns the pack: one fused multi-word kernel sweep
+        pack = engine
     log = spans.current_log()
     if log is not None:
         # Static byte accounting of the padded exchange (trace-time; see
@@ -172,7 +220,7 @@ def ragged_all_to_all(
             bytes=n_ranks * cap * itemsize + n_ranks * 4,
             wire_bytes=(n_ranks - 1) * cap * itemsize + (n_ranks - 1) * 4,
             ranks=n_ranks, cap=cap, n=n, arrays=len(arrays), pack=pack,
-            axis=axis,
+            engine=engine, axis=axis,
         )
     if pack == "xla":
         j = lax.iota(jnp.int32, n)
@@ -186,27 +234,41 @@ def ragged_all_to_all(
 
     # Explicit count exchange (replaces tag-as-length, mpi_sample_sort.c:161,168).
     recv_cnt = lax.all_to_all(jnp.minimum(send_cnt, cap), axis, 0, 0, tiled=True)
+    # Overlap hook: issued before the payload transport — depends only
+    # on the counts + replicated state, never on the payload DMAs.
+    pre_result = pre_exchange(recv_cnt) if pre_exchange is not None else None
 
     recv_arrays = []
-    for k, a in enumerate(arrays):
-        fillv = 0 if fill is None else fill[k]
-        if pack == "xla":
-            send = (
-                jnp.full((n_ranks * cap,), fillv, a.dtype)
-                .at[slot].set(a, mode="drop")
-                .reshape(n_ranks, cap)
-            )
-        else:
-            # Pallas DMA pack: whole-chunk copies, no per-element scatter
-            # (4.7× the XLA spread at 2^26 on v5e; ops/pallas_kernels.py).
-            from mpitest_tpu.ops.pallas_kernels import segment_pack
+    if use_pallas:
+        sends = xeng.fused_pass_pack(
+            tuple(arrays), send_start, send_cnt, cap, n_ranks,
+            fills=tuple(fill) if fill is not None else (0,) * len(arrays),
+            interpret=interp, vma=(axis,),
+        )
+        for send in sends:
+            recv_arrays.append(xeng.remote_a2a(send, n_ranks, axis,
+                                               interpret=interp))
+    else:
+        for k, a in enumerate(arrays):
+            fillv = 0 if fill is None else fill[k]
+            if pack == "xla":
+                send = (
+                    jnp.full((n_ranks * cap,), fillv, a.dtype)
+                    .at[slot].set(a, mode="drop")
+                    .reshape(n_ranks, cap)
+                )
+            else:
+                # Pallas DMA pack: whole-chunk copies, no per-element
+                # scatter (4.7× the XLA spread at 2^26 on v5e;
+                # ops/pallas_kernels.py).
+                from mpitest_tpu.ops.pallas_kernels import segment_pack
 
-            send = segment_pack(
-                a, send_start, send_cnt, cap, n_ranks, fill=fillv,
-                interpret=(pack == "pallas_interpret"), vma=(axis,),
-            )
-        recv = lax.all_to_all(send, axis, 0, 0, tiled=True)
-        recv_arrays.append(recv)
+                send = segment_pack(
+                    a, send_start, send_cnt, cap, n_ranks, fill=fillv,
+                    interpret=(pack == "pallas_interpret"), vma=(axis,),
+                )
+            recv = lax.all_to_all(send, axis, 0, 0, tiled=True)
+            recv_arrays.append(recv)
 
     # Fault injection (ISSUE 3): the armed exchange fault lands HERE —
     # between the all_to_all and the receiver's local sort/merge — the
@@ -217,4 +279,6 @@ def ragged_all_to_all(
                                                    recv_cnt)
 
     max_send_cnt = lax.pmax(send_cnt.max(), axis)
+    if pre_exchange is not None:
+        return recv_t, recv_cnt, max_send_cnt, pre_result
     return recv_t, recv_cnt, max_send_cnt

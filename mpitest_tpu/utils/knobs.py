@@ -322,6 +322,13 @@ register("SORT_LOCAL_ENGINE", "enum", "auto", "auto | bitonic | lax",
          "Local (single-device) sort engine; auto = bitonic on TPU.",
          _enum("SORT_LOCAL_ENGINE", ("auto", "bitonic", "lax")))
 
+register("SORT_EXCHANGE_ENGINE", "enum", "auto",
+         "auto | lax | pallas | pallas_interpret",
+         "Inter-device exchange engine (ops/exchange.py remote-DMA + "
+         "fused pass vs lax.all_to_all); auto = pallas on TPU.",
+         _enum("SORT_EXCHANGE_ENGINE",
+               ("auto", "lax", "pallas", "pallas_interpret")))
+
 
 def _parse_devices(raw: str) -> int | None:
     if raw == "auto":

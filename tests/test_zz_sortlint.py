@@ -123,8 +123,8 @@ def test_plan_registry_vocabulary():
     from mpitest_tpu.models import plan as plan_mod
 
     assert all(doc for doc in plan_mod.PLAN_DECISIONS.values())
-    assert {"algo", "cap", "restage", "engine", "passes", "ladder",
-            "batch"} == set(plan_mod.PLAN_DECISIONS)
+    assert {"algo", "cap", "restage", "engine", "exchange_engine",
+            "passes", "ladder", "batch"} == set(plan_mod.PLAN_DECISIONS)
 
 
 def test_metrics_registry_vocabulary():
@@ -166,6 +166,35 @@ def test_sl012_host_sync_inside_traced_fn():
     good = ("import numpy as np\n"
             "def h(x):\n    return np.asarray(x)\n")
     assert lint_source(good, "x.py") == []
+
+
+def test_sl013_pallas_call_home_and_interpret():
+    """ISSUE 13: pl.pallas_call lives only in mpitest_tpu/ops/, and the
+    entry point around it must expose an `interpret=` parameter so the
+    CPU parity gates can drive every kernel."""
+    call = ("from jax.experimental import pallas as pl\n"
+            "def launch(x: object, interpret: bool = False) -> object:\n"
+            "    return pl.pallas_call(lambda r, o: None,\n"
+            "                          interpret=interpret)(x)\n")
+    # outside ops/: flagged wherever it sits
+    assert rules_of(lint_source(call, "mpitest_tpu/models/x.py")) == ["SL013"]
+    assert rules_of(lint_source(call, "bench/x.py")) == ["SL013"]
+    # in ops/ with an interpret= entry-point parameter: clean
+    assert lint_source(call, "mpitest_tpu/ops/x.py") == []
+    # in ops/ but the entry point cannot be driven in interpret mode
+    no_interp = ("from jax.experimental import pallas as pl\n"
+                 "def launch(x):\n"
+                 "    return pl.pallas_call(lambda r, o: None)(x)\n")
+    assert rules_of(lint_source(no_interp, "mpitest_tpu/ops/x.py")) == \
+        ["SL013"]
+    # nested launcher inherits the outer entry point's parameter
+    nested = ("from jax.experimental import pallas as pl\n"
+              "def outer(x, interpret=False):\n"
+              "    def inner(y):\n"
+              "        return pl.pallas_call(lambda r, o: None,\n"
+              "                              interpret=interpret)(y)\n"
+              "    return inner(x)\n")
+    assert lint_source(nested, "mpitest_tpu/ops/x.py") == []
 
 
 def test_sl040_typed_core_annotations():

@@ -48,6 +48,14 @@ COLUMNS: tuple[tuple[str, str, str, bool], ...] = (
     ("plan_regret", "plan regret", "x", False),
 )
 
+#: String-valued trajectory columns (ISSUE 13): rendered verbatim, no
+#: regression math — the engine column exists so `exchange_engine=
+#: {lax,pallas}` rows land comparable from r06 onward (a throughput
+#: jump that coincides with an engine flip is attribution, not noise).
+LABEL_COLUMNS: tuple[tuple[str, str], ...] = (
+    ("exchange_engine", "engine"),
+)
+
 _RUN_RE = re.compile(r"BENCH_r(\d+)\.json$")
 
 #: Absolute floor for LOWER-is-better columns when comparing against
@@ -74,14 +82,17 @@ def _json_lines(text: str) -> list[dict]:
     return out
 
 
-def load_run(path: Path) -> dict[str, float]:
+def load_run(path: Path) -> dict[str, object]:
     """Extract the trajectory metrics from one BENCH_rNN.json envelope.
     Both record shapes in the tail are folded: metrics sidecars
     (``{"config", "metrics": {name: {"value": ...}}}``) and bench rows
     (``{"metric", "value", ...}`` — including the ``_8dev`` scale-out
-    and serve rows with their extra fields)."""
+    and serve rows with their extra fields).  Numeric trajectory values
+    keyed by metric name, plus a ``"_labels"`` dict of string columns
+    (the ISSUE 13 engine column)."""
     env = json.loads(path.read_text())
     vals: dict[str, float] = {}
+    labels: dict[str, str] = {}
 
     def put(name: str, v: object) -> None:
         try:
@@ -106,6 +117,11 @@ def load_run(path: Path) -> dict[str, float]:
                 put("sort_row_mkeys_per_s", obj["value"])
                 if "plan_regret" not in vals:
                     put("plan_regret", obj.get("plan_regret"))
+                # ISSUE 13: the primary row's exchange engine (pre-r06
+                # rounds predate the field and render "-")
+                if isinstance(obj.get("exchange_engine"), str):
+                    labels["exchange_engine"] = obj["exchange_engine"]
+    vals["_labels"] = labels  # type: ignore[assignment]
     # derived: end-to-end ratio when a round recorded both throughputs
     # but not the ratio itself (pre-ISSUE-6 rounds)
     if "ingest_ratio" not in vals and \
@@ -138,15 +154,17 @@ def build_table(runs: list[tuple[int, Path]],
     rows = [(rid, load_run(p)) for rid, p in runs]
     flags: list[str] = []
     header = "| run | " + " | ".join(
-        f"{title} ({unit})" for _k, title, unit, _hib in COLUMNS) + " |"
-    sep = "|---" * (len(COLUMNS) + 1) + "|"
+        f"{title} ({unit})" for _k, title, unit, _hib in COLUMNS)
+    header += " | " + " | ".join(t for _k, t in LABEL_COLUMNS) + " |"
+    sep = "|---" * (len(COLUMNS) + len(LABEL_COLUMNS) + 1) + "|"
     lines = [header, sep]
     best: dict[str, float] = {}
     for rid, vals in rows:
+        labels = vals.get("_labels") or {}
         cells = [f"r{rid:02d}"]
         for key, title, _unit, hib in COLUMNS:
             v = vals.get(key)
-            if v is None:
+            if not isinstance(v, (int, float)):
                 cells.append("-")
                 continue
             cell = f"{v:g}"
@@ -164,6 +182,9 @@ def build_table(runs: list[tuple[int, Path]],
             best[key] = max(prev, v) if (prev is not None and hib) else \
                 min(prev, v) if prev is not None else v
             cells.append(cell)
+        for key, _title in LABEL_COLUMNS:
+            lv = labels.get(key) if isinstance(labels, dict) else None
+            cells.append(lv if isinstance(lv, str) else "-")
         lines.append("| " + " | ".join(cells) + " |")
     return "\n".join(lines), flags
 

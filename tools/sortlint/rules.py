@@ -453,6 +453,62 @@ register(Rule(
     _check_host_sync))
 
 
+# ---------------------------------------------------------------- SL013
+
+#: The kernel home: the ONE directory Pallas lowering may live in.
+#: Everything else composes kernels through these entry points, so the
+#: interpret-mode parity gates (bitonic suite, exchange engine axis)
+#: cover every kernel the production paths can reach.
+_PALLAS_HOME = "mpitest_tpu/ops/"
+
+
+def _fn_params(node: ast.FunctionDef | ast.AsyncFunctionDef) -> set[str]:
+    a = node.args
+    return {p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs
+                            + ([a.vararg] if a.vararg else [])
+                            + ([a.kwarg] if a.kwarg else []))}
+
+
+def _check_pallas_home(path: str, src: str, tree: ast.AST) -> list[Finding]:
+    p = path.replace("\\", "/")
+    in_home = ("/" + _PALLAS_HOME in p) or p.startswith(_PALLAS_HOME)
+    out = []
+
+    def visit(node: ast.AST,
+              fn_stack: tuple[ast.FunctionDef | ast.AsyncFunctionDef, ...],
+              ) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn_stack = fn_stack + (node,)
+        if isinstance(node, ast.Call) and \
+                _attr_chain(node.func).split(".")[-1] == "pallas_call":
+            if not in_home:
+                out.append(Finding(
+                    "SL013", path, node.lineno,
+                    "pl.pallas_call outside mpitest_tpu/ops/ — kernels "
+                    "live in ops/ behind interpret-capable entry points "
+                    "so the CPU parity gates can exercise them; compose "
+                    "the existing ops/ entry points instead"))
+            elif not any("interpret" in _fn_params(f) for f in fn_stack):
+                out.append(Finding(
+                    "SL013", path, node.lineno,
+                    "pallas_call inside an entry point with no "
+                    "`interpret=` parameter — every kernel entry point "
+                    "must be drivable by the interpret-mode parity "
+                    "gates (tests/bitonic suite, exchange engine axis)"))
+        for child in ast.iter_child_nodes(node):
+            visit(child, fn_stack)
+
+    visit(tree, ())
+    return out
+
+
+register(Rule(
+    "SL013", "pallas-kernel-home",
+    "pl.pallas_call only inside mpitest_tpu/ops/, behind interpret= "
+    "entry points",
+    _check_pallas_home))
+
+
 # ---------------------------------------------------------------- SL020
 
 def _parse_sites(faults_path: Path) -> list[str]:

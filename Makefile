@@ -92,8 +92,8 @@ PYTHON ?= python3
 
 .PHONY: test native native-encode chip-test telemetry-selftest \
     ingest-selftest fault-selftest multichip-selftest serve-selftest \
-    chaos-serve-selftest lint cwarn-check typecheck tidy-check \
-    knob-docs sanitize-selftest bench-history clean
+    chaos-serve-selftest planner-selftest lint cwarn-check typecheck \
+    tidy-check knob-docs sanitize-selftest bench-history clean
 
 chip-test:
 	$(PYTHON) -u bench/chip_regression.py
@@ -196,6 +196,24 @@ serve-selftest:
 	    $(SERVE_TMP)/server_trace_batched.jsonl
 	$(PYTHON) -m mpitest_tpu.report \
 	    $(SERVE_TMP)/server_trace_batched.jsonl $(SERVE_TMP)/metrics.jsonl
+
+# The self-tuning planner gate (ISSUE 14) — see
+# bench/planner_selftest.py.  The adversarial mix (sorted/near-sorted/
+# dup/skew/uniform, cpu:8 virtual mesh) planner-off vs planner-on:
+# throughput >= 1.3x, aggregate plan_regret strictly lower, planner-off
+# AND shadow byte-identical; plus the serve window-auto A/B against a
+# mis-set fixed window.  The final report pass renders the explain
+# trees (planner policy census included) from the recorded metrics.
+PLANNER_TMP := /tmp/mpitest_planner_selftest
+planner-selftest:
+	rm -rf $(PLANNER_TMP) && mkdir -p $(PLANNER_TMP)
+	JAX_PLATFORMS=cpu \
+	    SORT_METRICS=$(PLANNER_TMP)/metrics.jsonl \
+	    SORT_TRACE=$(PLANNER_TMP)/trace.jsonl \
+	    $(PYTHON) -u bench/planner_selftest.py --out $(PLANNER_TMP)
+	$(PYTHON) -m mpitest_tpu.report --check --require-registered-spans \
+	    $(PLANNER_TMP)/trace.jsonl
+	$(PYTHON) -m mpitest_tpu.report --explain $(PLANNER_TMP)/trace.jsonl
 
 # The wire-chaos gate (ISSUE 11) — see bench/chaos_serve_selftest.py.
 # Real servers behind the chaos TCP proxy on a plain 1-device CPU

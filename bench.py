@@ -195,6 +195,8 @@ def _measure_multichip(algo: str, dtype: np.dtype, log2n: int,
         # rows pin lax for trajectory comparability; the pallas smoke
         # cell below carries the new engine's parity evidence).
         "exchange_engine": c.get("exchange_engine", "lax"),
+        # ISSUE 14: planner column (pinned off on measured rows).
+        "planner": str(knobs.get("SORT_PLANNER")),
     }
     metrics = Metrics(config={"platform": platform, "algo": algo,
                               "log2n": log2n, "dtype": dtype.name,
@@ -345,6 +347,31 @@ def _emit_serve_row() -> None:
                 pass
 
 
+def _emit_planner_row() -> None:
+    """Fourth JSONL row (ISSUE 14): the adversarial-mix measurement —
+    ``bench/planner_selftest.py --row`` runs the sorted/near-sorted/
+    dup/skew/uniform mix on a cpu:8 virtual mesh (its own subprocess,
+    like the multichip fallback) with the planner PINNED OFF, so the
+    r01+ trajectory stays policy-comparable; the planner's on-vs-off
+    evidence is `make planner-selftest`.  Best-effort by contract."""
+    try:
+        r = subprocess.run(
+            [sys.executable, str(REPO / "bench" / "planner_selftest.py"),
+             "--row"],
+            capture_output=True, text=True, timeout=1800)
+        for line in r.stderr.splitlines():
+            log(f"planner| {line}")
+        rows = [ln for ln in r.stdout.splitlines() if ln.strip()]
+        if r.returncode != 0 or not rows:
+            log(f"planner: row run failed (rc={r.returncode}); "
+                "omitting row")
+            return
+        row = json.loads(rows[-1])  # re-validate before re-emitting
+        print(json.dumps(row))
+    except Exception as e:  # noqa: BLE001 — the row is best-effort
+        log(f"planner: skipped ({type(e).__name__}: {e})")
+
+
 def multichip_main() -> None:
     """``bench.py --multichip-row``: measure ONLY the devices=8 row (the
     subprocess side of :func:`_emit_multichip_row`)."""
@@ -370,6 +397,7 @@ def multichip_main() -> None:
     os.environ.setdefault("SORT_FALLBACK", "0")
     os.environ.setdefault("SORT_MAX_RETRIES", "0")
     os.environ.setdefault("SORT_EXCHANGE_ENGINE", "lax")
+    os.environ.setdefault("SORT_PLANNER", "off")
     platform = jax.devices()[0].platform
     if len(jax.devices()) < MULTICHIP_DEVICES:
         raise SystemExit(
@@ -519,6 +547,11 @@ def main() -> None:
     # Remove the pin deliberately (SORT_EXCHANGE_ENGINE=pallas) when a
     # TPU round is ready to re-baseline the trajectory.
     os.environ.setdefault("SORT_EXCHANGE_ENGINE", "lax")
+    # ISSUE 14: measured rows pin the planner off for the same reason —
+    # a policy flip (passthrough, algo reroute, learned margin) must
+    # never silently rewrite the r01+ trajectory; the planner's own
+    # evidence is `make planner-selftest`'s A/B gate.
+    os.environ.setdefault("SORT_PLANNER", "off")
 
     platform = jax.devices()[0].platform
     on_tpu = platform not in ("cpu",)
@@ -756,6 +789,9 @@ def main() -> None:
         "verify_overhead_s": verify_s,
         "encode_engine": encode_engine,
         "exchange_engine": tracer.counters.get("exchange_engine", "lax"),
+        # ISSUE 14: the planner column — measured rows pin "off" (see
+        # the setdefault above); string cell, no regression math.
+        "planner": str(knobs.get("SORT_PLANNER")),
         "tooling": tooling_state(),
     }
     if encode_gbs is not None:
@@ -799,6 +835,16 @@ def main() -> None:
         else:
             log(f"serve: skipped at 2^{log2n} (scale-gated like the "
                 "multichip row; run bench/serve_load.py --row directly)")
+
+    # Fourth JSONL row (ISSUE 14): the adversarial-mix measurement,
+    # planner pinned off for trajectory comparability.  Scale-gated
+    # like the serve row.
+    if knobs.get("BENCH_PLANNER") != "off":
+        if log2n >= 16:
+            _emit_planner_row()
+        else:
+            log(f"planner: skipped at 2^{log2n} (scale-gated; run "
+                "bench/planner_selftest.py --row directly)")
 
 
 if __name__ == "__main__":

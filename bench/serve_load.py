@@ -487,6 +487,93 @@ def record_metrics(stats: dict, speedup: float | None) -> None:
     m.dump(knobs.get("SORT_METRICS"))
 
 
+# ----------------------------------------------------------- planner leg
+
+#: --planner A/B leg (ISSUE 14): both servers run the same deliberately
+#: mis-set fixed window — small enough that a closed-loop round's worth
+#: of tenants cannot pack — and only the planner-on server may re-size
+#: it from the observed mix.  The A/B isolates the tuner's value.
+PLANNER_FIXED_WINDOW_MS = "1"
+
+#: Warmup requests of the planner leg: the tuner commits after two
+#: consecutive agreeing evaluations (RETUNE_EVERY observations each),
+#: so the warm phase must span >= 2 evaluation rounds.
+PLANNER_WARMUP_REQUESTS = 96
+
+
+def planner_phase(out: Path, requests: int, concurrency: int,
+                  seed: int) -> dict:
+    """Window-auto vs fixed-window dispatch throughput and p99 beside
+    the clean row (ISSUE 14).  Both legs keep the full correctness
+    contract: every reply bit-identical to ``np.sort``, clean SIGTERM,
+    and ``reconcile_with_server`` still exact (the tuner must never
+    cost a reply).  Returns the extra row fields (``None`` values when
+    a leg failed its correctness checks)."""
+    fields: dict = {"planner_fixed_window_ms":
+                    float(PLANNER_FIXED_WINDOW_MS),
+                    "planner_dispatch_mkeys_per_s": None,
+                    "fixed_dispatch_mkeys_per_s": None,
+                    "p99_planner_ms": None, "p99_fixed_ms": None,
+                    "planner_window_retunes": None}
+    legs: dict[str, tuple[dict, list[dict], str]] = {}
+    for tag, mode in (("planner_fixed", "off"), ("planner_auto", "on")):
+        srv = Server(out, tag, {
+            "SORT_SERVE_BATCH_WINDOW_MS": PLANNER_FIXED_WINDOW_MS,
+            "SORT_PLANNER": mode,
+        })
+        try:
+            warm = run_load(srv.port, PLANNER_WARMUP_REQUESTS,
+                            concurrency, seed + 2000)
+            phase_stats(f"{tag} warmup", warm)
+            cut = srv.trace_cut()
+            stats = run_load(srv.port, requests, concurrency, seed + 2500)
+            phase_stats(tag, stats)
+            spans = srv.spans_after(cut)
+            combined = dict(warm["statuses"])
+            for k, v in stats["statuses"].items():
+                combined[k] = combined.get(k, 0) + v
+            prom = srv.scrape_metrics()
+            errs = reconcile_with_server(prom, combined)
+        except (OSError, ConnectionError, RuntimeError) as e:
+            log(f"planner leg {tag} failed: {e}")
+            srv.stop()
+            return fields
+        rc = srv.stop()
+        if rc != 0 or stats["bad_parity"] or errs:
+            log(f"planner leg {tag} FAILED correctness: rc={rc} "
+                f"bad_parity={stats['bad_parity']} errs={errs}")
+            return fields
+        (out / f"metrics_{tag}.prom").write_text(prom)
+        legs[tag] = (stats, spans, prom)
+    fixed_stats, fixed_spans, _ = legs["planner_fixed"]
+    auto_stats, auto_spans, auto_prom = legs["planner_auto"]
+    retunes = 0.0
+    try:
+        fams = metrics_live.parse_prom_text(auto_prom)
+        ret = fams.get("sort_serve_window_retunes_total")
+        if ret:
+            retunes = sum(v for _n, _l, v in ret["samples"])
+    except ValueError:
+        pass
+    fields.update({
+        "planner_dispatch_mkeys_per_s":
+            round(dispatch_mkeys_per_s(auto_spans), 3),
+        "fixed_dispatch_mkeys_per_s":
+            round(dispatch_mkeys_per_s(fixed_spans), 3),
+        "p99_planner_ms":
+            round(percentile(auto_stats["latencies"], 99) * 1e3, 3),
+        "p99_fixed_ms":
+            round(percentile(fixed_stats["latencies"], 99) * 1e3, 3),
+        "planner_window_retunes": int(retunes),
+    })
+    log(f"planner leg: dispatch {fields['planner_dispatch_mkeys_per_s']}"
+        f" (auto, {fields['planner_window_retunes']} retune(s)) vs "
+        f"{fields['fixed_dispatch_mkeys_per_s']} Mkeys/s (fixed "
+        f"{PLANNER_FIXED_WINDOW_MS} ms); p99 {fields['p99_planner_ms']}"
+        f" vs {fields['p99_fixed_ms']} ms")
+    return fields
+
+
 # ------------------------------------------------------------- chaos leg
 
 def chaos_phase(out: Path, seed: int) -> dict:
@@ -707,6 +794,11 @@ def main() -> int:
                          "injected response-delay tail, plain AND "
                          "hedged, recorded in the row beside the "
                          "clean numbers (ISSUE 11)")
+    ap.add_argument("--planner", action="store_true",
+                    help="also measure the window-auto vs fixed-window "
+                         "A/B (SORT_PLANNER=on vs off at a mis-set "
+                         "fixed window), recorded in the row beside "
+                         "the clean numbers (ISSUE 14)")
     ap.add_argument("--out", default="/tmp/mpitest_serve_load",
                     help="artifact dir (server traces)")
     ap.add_argument("--requests", type=int, default=160)
@@ -734,9 +826,15 @@ def main() -> int:
         return 1
     extra = {"concurrency": args.concurrency,
              "dispatch_mkeys_per_s":
-             round(dispatch_mkeys_per_s(spans), 3)}
+             round(dispatch_mkeys_per_s(spans), 3),
+             # ISSUE 14: the planner column (the measured phase runs
+             # whatever the spawn env set — off unless overridden)
+             "planner": str(knobs.get("SORT_PLANNER"))}
     if args.chaos:
         extra.update(chaos_phase(out, args.seed))
+    if args.planner:
+        extra.update(planner_phase(out, args.requests,
+                                   args.concurrency, args.seed))
     emit_row(stats, extra)
     record_metrics(stats, None)
     return 0

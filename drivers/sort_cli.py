@@ -144,6 +144,10 @@ def main(argv: list[str] | None = None) -> int:
             "SORT_FLIGHT_RECORDER_DIR",
             # plan provenance (ISSUE 12): minted on every run by default
             "SORT_PLAN",
+            # self-tuning planner (ISSUE 14): the policy layer rides
+            # every sort when enabled, so garbage dies here
+            "SORT_PLANNER", "SORT_PLANNER_WINDOW",
+            "SORT_PLANNER_HYSTERESIS",
         )
         # resolve the encode engine NOW: SORT_NATIVE_ENCODE=on with no
         # usable libencode.so is one clean [ERROR] line here, never a

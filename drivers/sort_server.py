@@ -98,6 +98,10 @@ def main(argv: list[str] | None = None) -> int:
             # plan provenance (ISSUE 12): the decision record behind
             # the response header's plan digest and /varz snapshot
             "SORT_PLAN",
+            # self-tuning planner (ISSUE 14): per-request policies +
+            # the serve window/bucket tuner
+            "SORT_PLANNER", "SORT_PLANNER_WINDOW",
+            "SORT_PLANNER_HYSTERESIS",
         )
         from mpitest_tpu.utils import native_encode
 

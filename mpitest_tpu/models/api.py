@@ -41,6 +41,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from mpitest_tpu import compat, faults
 from mpitest_tpu.models import plan as plan_mod
+from mpitest_tpu.models import planner as planner_mod
 from mpitest_tpu.models import radix_sort, sample_sort
 from mpitest_tpu.models import supervisor as supervision
 from mpitest_tpu.models import verify as vfy
@@ -1263,6 +1264,55 @@ def _sort_impl(
     # supervisor object exists below.
     supervision.wire_registry(reg, tracer)
 
+    # ---- self-tuning planner (ISSUE 14): the policy layer -----------
+    # off: nothing below runs — the hand-set defaults byte-for-byte.
+    # shadow: every policy is scored and logged as the registered
+    # `planner` plan decision (applied=False) while the output path
+    # stays untouched.  on: the algo policy may override `algorithm`
+    # and the learned margin replaces SAMPLE_NEG_MARGIN.  The planner
+    # rides the plan record, so SORT_PLAN=off also disables it.
+    planner_mode = planner_mod.mode()
+    pchoice: "planner_mod.PolicyChoice | None" = None
+    neg_margin = SAMPLE_NEG_MARGIN
+    if planner_mode != "off" and plan is not None:
+        pchoice = planner_mod.choose(plan.profile, algorithm,
+                                     verify_on=verify_on)
+        # the margin only steers the sample negotiation: requests bound
+        # for radix, 1-rank runs (no exchange) and negotiate-off runs
+        # skip the flight-ring scan entirely — and never record
+        # cap_margin as an applied policy they cannot act on (a
+        # passthrough miss over a sample request still falls into the
+        # sample path, so those keep it)
+        if ((pchoice.algo or algorithm) == "sample"
+                and _negotiation_enabled(n_ranks)):
+            margin, margin_ev = planner_mod.learned_margin(
+                SAMPLE_NEG_MARGIN)
+        else:
+            margin, margin_ev = SAMPLE_NEG_MARGIN, {}
+        # the RECORDED policy: when the algo scorer chose nothing but
+        # the margin policy learned, the margin IS the planner's move
+        name = pchoice.policy
+        if name == "static" and margin_ev.get("margin_learned"):
+            name = "cap_margin"
+        planner_mod.policy(name)  # runtime twin of SL006: loud KeyError
+        plan.decide("planner", chosen=name, requested="static",
+                    trigger=pchoice.trigger,
+                    applied=(planner_mode == "on"),
+                    algo=pchoice.algo, margin=round(margin, 4),
+                    **dict(pchoice.predicted, **margin_ev))
+        tracer.counters["planner"] = planner_mode
+        tracer.counters["planner_policy"] = name
+        if planner_mode == "on":
+            neg_margin = margin
+            if pchoice.algo is not None and pchoice.algo != algorithm:
+                # the scored reroute: recorded exactly like the sniff/
+                # probe reroutes, so plan_regret now measures the
+                # planner itself (a wrong choice shows up as algo/cap
+                # regret on a planner-triggered decision)
+                plan.decide("algo", chosen=pchoice.algo,
+                            trigger="planner")
+                algorithm = pchoice.algo
+
     def _check_result(res_v, fp_v) -> bool:
         """Run the on-device verifier on a result; True = verified.
         Emits the ``verify`` span event (ok / sorted_ok / fp_ok) the
@@ -1380,6 +1430,30 @@ def _sort_impl(
                     checked_device_put(w, mesh.devices.flat[0])
                     for w in words_np
                 )
+            # planner rung zero, local edition (ISSUE 14): same contract
+            # as the distributed rung below — the profile read fully
+            # sorted, so the encoded input words ARE a sort candidate;
+            # one verify dispatch replaces the local sort when it
+            # passes, and a miss costs exactly the verify (typed as the
+            # planner decision's regret) before the sort runs.  This is
+            # the only 1-rank site the policy can reach: device-resident
+            # and staged inputs take no host profile, so the scorer
+            # already chose `static` for them.
+            if (pchoice is not None and planner_mode == "on" and verify_on
+                    and pchoice.policy == "verify_passthrough"
+                    and fp_in is not None):
+                cand = DistributedSortResult(words, N, dtype)
+                if _check_result(cand, fp_in):
+                    tracer.count("planner_passthrough", 1)
+                    if plan is not None:
+                        plan.decide("ladder", chosen="passthrough")
+                    if return_result:
+                        return cand
+                    with tracer.phase("decode"):
+                        return cand.to_numpy(tracer=tracer)
+                tracer.count("planner_passthrough_miss", 1)
+                if plan is not None:
+                    plan.actual("planner", misses=1)
             with tracer.phase("sort"):
                 out = _traced_call(tracer, "local",
                                    _compile_local(codec.n_words,
@@ -1756,9 +1830,12 @@ def _sort_impl(
         if negotiate:
             cnts = _negotiate("sample")
             # the sample probe is an ESTIMATE (sampled splitters) —
-            # margin on top, and the regrow loop stays as backstop
+            # margin on top, and the regrow loop stays as backstop.
+            # neg_margin is SAMPLE_NEG_MARGIN unless the planner is ON
+            # and learned a tighter one from the flight ring's observed
+            # estimate-error quantiles (ISSUE 14 cap/margin policy).
             need = _round_cap(
-                int(float(cnts.max()) * SAMPLE_NEG_MARGIN) + 1, eff_align)
+                int(float(cnts.max()) * neg_margin) + 1, eff_align)
             if need > cap_limit:
                 # the estimate already busts the O(n) recv bound: route
                 # to radix NOW instead of paying a doomed full exchange
@@ -1775,7 +1852,8 @@ def _sort_impl(
             cap_start = need
             if plan is not None:
                 plan.decide("cap", chosen=cap_start, trigger="estimate",
-                            cap=cap_start, need=int(cnts.max()), fair=fair)
+                            cap=cap_start, need=int(cnts.max()), fair=fair,
+                            margin=round(neg_margin, 4))
             _balance_event(cnts, "sample", False, cap_start,
                            _restaged["done"])
         elif plan is not None:
@@ -1891,7 +1969,31 @@ def _sort_impl(
     #: stamp every descent off a pallas rung as a kernel fault.
     last_fail = "dispatch"
     level = rungs[0][0]
-    for level, rung_eng in rungs:
+
+    # ---- planner rung zero (ISSUE 14): verify-passthrough -----------
+    # The profile's strided sample read fully sorted, so the staged
+    # input words ARE a sort candidate: one O(n) verify dispatch (the
+    # same always-on gate every ladder rung faces) replaces the whole
+    # sort when it passes.  A miss — the sample hid a descent — costs
+    # exactly the verify pass (the planner decision's regret) and the
+    # ordinary ladder below sorts for real.  Only in `on` mode and only
+    # with the verifier armed: without it the profile is a guess, and a
+    # guess must not skip the sort.
+    if (pchoice is not None and planner_mode == "on" and verify_on
+            and pchoice.policy == "verify_passthrough"
+            and input_fp is not None):
+        cand = DistributedSortResult(live_words(), N, dtype)
+        if _check_result(cand, input_fp):
+            tracer.count("planner_passthrough", 1)
+            if plan is not None:
+                plan.decide("ladder", chosen="passthrough")
+            res = cand
+        else:
+            tracer.count("planner_passthrough_miss", 1)
+            if plan is not None:
+                plan.actual("planner", misses=1)
+
+    for level, rung_eng in (() if res is not None else rungs):
         if rung_eng != _eng["v"]:
             tracer.verbose(
                 f"degrading exchange engine {_eng['v']} -> {rung_eng}")

@@ -68,6 +68,12 @@ PLAN_DECISIONS: dict[str, str] = {
                "and supervisor dispatch retries are its regret"),
     "batch": ("serve batching: window close reason, members packed, "
               "bucket chosen; predicted vs actual padded-lane waste"),
+    "planner": ("self-tuning planner verdict (ISSUE 14): the scored "
+                "policy (models/planner.py PLANNER_POLICIES, SL006), "
+                "its profile trigger, whether it was applied (on) or "
+                "only logged (shadow), the learned margin evidence; a "
+                "passthrough miss (the strided profile lied and the "
+                "verify pass was wasted) is this decision's regret"),
 }
 
 #: Registered input-distribution profile fields (the probe-riding
@@ -259,6 +265,13 @@ class SortPlan:
         if d.name == "engine":
             # an engine whose residual fallback ran paid both engines
             return float(a.get("fallbacks", 0) or 0)
+        if d.name == "planner":
+            # the planner's own cost: each passthrough miss paid one
+            # verify dispatch that proved nothing (the strided profile
+            # hid a descent) before the ladder sorted for real.  A
+            # shadow decision (applied False) changed nothing and can
+            # regret nothing.
+            return float(a.get("misses", 0) or 0)
         if d.name == "exchange_engine":
             # either degrade cause paid every dispatch up to the switch
             # before the lax rung re-ran the whole algorithm; the
@@ -323,6 +336,12 @@ class SortPlan:
         batch = self.decisions.get("batch")
         if batch is not None:
             out["bucket"] = _scalar(batch.chosen)
+        pl = self.decisions.get("planner")
+        if pl is not None:
+            # the planner's verdict rides the wire digest so clients
+            # (and the serve_load plan fold) see policy drift directly
+            out["planner"] = _scalar(pl.chosen)
+            out["planner_regret"] = pl.regret
         return out
 
 

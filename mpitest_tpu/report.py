@@ -571,6 +571,24 @@ def explain_view(rows: list[dict], trace_id: str | None = None,
                 f"  {name:<10} {row['count']:>6} "
                 f"{row['regret_sum'] / row['count']:>10.4f} "
                 f"{row['regret_max']:>10.4f}")
+        # planner policy census (ISSUE 14): which registered policies
+        # the self-tuning planner chose across these plans, split by
+        # whether it acted (on) or only logged (shadow)
+        census: dict[tuple[str, bool], int] = {}
+        for p in plans:
+            d = ((p.get("attrs") or {}).get("decisions") or {}
+                 ).get("planner")
+            if not isinstance(d, dict):
+                continue
+            applied = bool((d.get("predicted") or {}).get("applied"))
+            key = (str(d.get("chosen", "?")), applied)
+            census[key] = census.get(key, 0) + 1
+        if census:
+            out.append("planner policies")
+            for (pol, applied), cnt in sorted(census.items()):
+                out.append(f"  {pol:<20} "
+                           f"{'applied' if applied else 'shadow':<8} "
+                           f"{cnt:>6}")
     return "\n".join(out).rstrip()
 
 

@@ -143,6 +143,8 @@ class Batcher:
         self.batched_requests = 0
         self.solo_requests = 0
         self.deadline_cancelled = 0
+        #: live window re-sizes applied via set_window (ISSUE 14).
+        self.window_retunes = 0
         #: dispatch heartbeat (ISSUE 11): (monotonic start, kind,
         #: trace_ids) while an executor call is live, None otherwise —
         #: the watchdog's only evidence, so it is set/cleared under a
@@ -155,6 +157,16 @@ class Batcher:
 
     def submit(self, req: ServeRequest) -> None:
         self._q.put(req)
+
+    def set_window(self, window_s: float) -> None:
+        """Re-size the batching window live (ISSUE 14: the serve
+        tuner's actuator).  A single float attribute swap — GIL-atomic
+        against the dispatch loop, which re-reads ``window_s`` at every
+        pack open, so the new value governs the NEXT window and never
+        tears one already collecting.  Callers own the hysteresis; this
+        method just applies."""
+        self.window_s = float(window_s)
+        self.window_retunes += 1
 
     # -- watchdog surface (ISSUE 11) ----------------------------------
     def inflight_dispatch(self) -> "tuple[float, str, list[str]] | None":

@@ -116,6 +116,58 @@ class ExecutorCache:
                         compile_s=round(dt, 6), **executable_stats(exe))
             return exe
 
+    def missing_packed(self, buckets: "tuple[int, ...]",
+                       dtype_names: "tuple[str, ...]",
+                       ) -> "tuple[tuple[int, str], ...]":
+        """The (bucket, dtype) pairs no packed executable exists for
+        yet (ISSUE 14: the serve tuner checks this before spawning a
+        background prewarm — an already-covered recommendation must
+        cost a lock acquire, not a thread).  Dtype-aware on purpose:
+        executables are keyed per dtype, so an int32 build at a bucket
+        does not cover a uint64 mix at the same bucket."""
+        import numpy as np
+
+        from mpitest_tpu.ops.keys import codec_for
+
+        out: "list[tuple[int, str]]" = []
+        with self._lock:
+            for dn in dtype_names:
+                nwt = 1 + codec_for(np.dtype(dn)).n_words
+                for b in buckets:
+                    if ("packed", b, dn, nwt) not in self._entries:
+                        out.append((b, dn))
+        return tuple(out)
+
+    def _build_detached(self, bucket: int, dtype_name: str,
+                        n_words_total: int) -> None:
+        """Compile one packed executable WITHOUT holding the cache lock
+        for the compile (ISSUE 14: the tuner's mid-traffic background
+        prewarm must never stall a live ``get_packed`` — an XLA compile
+        under ``self._lock`` would block the dispatch thread even on
+        already-cached keys).  The trade is the reverse race:
+        ``get_packed`` may compile the same cold key concurrently; both
+        pay the compile, the first insert wins, and the dispatch path
+        never waits on prewarm."""
+        key = ("packed", bucket, dtype_name, n_words_total)
+        with self._lock:
+            if key in self._entries:
+                self.stats.hits += 1
+                self._event(hit=True, bucket=bucket, dtype=dtype_name)
+                return
+        t0 = time.perf_counter()
+        exe = compile_packed_sort(n_words_total, bucket)
+        dt = time.perf_counter() - t0
+        with self._lock:
+            fresh = key not in self._entries
+            if fresh:
+                self._entries[key] = exe
+                self.stats.misses += 1
+                self.stats.compile_s += dt
+                self.stats.buckets.add(bucket)
+        if fresh:
+            self._event(hit=False, bucket=bucket, dtype=dtype_name,
+                        compile_s=round(dt, 6), **executable_stats(exe))
+
     # -- prewarm ------------------------------------------------------
     def prewarm(self, buckets: "tuple[int, ...]", dtype_names: tuple,
                 log: Callable[[str], None] = lambda m: None) -> int:
@@ -144,7 +196,7 @@ class ExecutorCache:
         for dtype_name in dtype_names:
             n_words = codec_for(np.dtype(dtype_name)).n_words
             for b in buckets:
-                self.get_packed(b, dtype_name, 1 + n_words)
+                self._build_detached(b, dtype_name, 1 + n_words)
                 built += 1
         self.stats.prewarmed += built
         log(f"prewarmed {built} executable(s) "

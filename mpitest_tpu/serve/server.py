@@ -68,6 +68,7 @@ import numpy as np
 
 from mpitest_tpu import faults
 from mpitest_tpu.models import plan as plan_mod
+from mpitest_tpu.models import planner as planner_mod
 from mpitest_tpu.models import segmented
 from mpitest_tpu.models import supervisor as supervision
 from mpitest_tpu.serve.admission import AdmissionControl, AdmissionReject
@@ -187,6 +188,26 @@ class ServerCore:
         self._batch_seq = 0
         self.batcher = Batcher(self._run_batch, self._run_solo,
                                window_ms / 1e3, self.batch_keys)
+        # seed the gauge with the configured window so a scrape can
+        # always tell "initial value" from "metric missing" (retunes
+        # overwrite it)
+        self.metrics.gauge("sort_serve_batch_window_ms").set(window_ms)
+        #: serve-side auto-tuning (ISSUE 14): rolling request-mix
+        #: observer + two-phase hysteresis re-sizing the batching
+        #: window and the prewarm buckets.  `shadow` computes and logs
+        #: every recommendation without touching the batcher; `on`
+        #: acts.  None when SORT_PLANNER=off — and when the operator
+        #: set window 0 (solo dispatch): there is no batching window to
+        #: tune, and the tuner's clamp floor (MIN_WINDOW_S) could only
+        #: ever override that explicit config, never restore it.
+        self.planner_mode = planner_mod.mode()
+        self.tuner: "planner_mod.ServeTuner | None" = None
+        if self.planner_mode != "off" and window_ms > 0:
+            self.tuner = planner_mod.ServeTuner(
+                window=knobs.get("SORT_PLANNER_WINDOW"),
+                hysteresis=knobs.get("SORT_PLANNER_HYSTERESIS"),
+                batch_keys=self.batch_keys,
+                initial_window_s=window_ms / 1e3)
         #: circuit breaker + dispatch watchdog (ISSUE 11).  The breaker
         #: is always consulted by admission; the watchdog THREAD only
         #: runs when start_watchdog() is called (the server driver does;
@@ -410,6 +431,9 @@ class ServerCore:
             batchable=(faults_spec is None
                        and int(arr.size) <= self.batch_keys),
             faults=faults_spec, trace_id=trace_id, deadline=deadline)
+        # serve auto-tuning (ISSUE 14): every admitted request feeds
+        # the rolling mix the window/bucket policies learn from
+        self._tuner_observe(int(arr.size), req.dtype.name)
         if req.expired():
             # stage "admission": the deadline died while the payload
             # was read/admitted — never enqueued, never dispatched
@@ -443,6 +467,70 @@ class ServerCore:
                                      trace_id)
             return self._finish(t0, attrs, req.error[0], req.error[1])
         return self._finish(t0, attrs, "ok", req.result)
+
+    def _tuner_observe(self, n: int, dtype_name: str = "int32") -> None:
+        """Feed the serve tuner one admitted request (ISSUE 14) and,
+        every RETUNE_EVERY observations, evaluate the mix.  A committed
+        recommendation re-sizes the live batching window (`on` mode
+        only — `shadow` logs the would-have-been retune and changes
+        nothing) and background-prewarms any (bucket, dtype) pair the
+        observed size/dtype mix says it needs.  Every commit is a
+        registered `planner` plan decision in the span stream, so
+        window drift is explainable from the same record as everything
+        else."""
+        tuner = self.tuner
+        if tuner is None:
+            return
+        if not tuner.observe(time.monotonic(), n, dtype_name):
+            return
+        verdict = tuner.evaluate()
+        if verdict is None or verdict[0] != "retune":
+            return
+        _action, rec = verdict
+        applied = self.planner_mode == "on"
+        want = tuple(sorted({
+            segmented.bucket_for(int(rec["p99_n"])),
+            segmented.bucket_for(int(rec["expected_batch_keys"]))}))
+        dtypes = tuple(rec.get("dtypes") or ("int32",))
+        missing = self.cache.missing_packed(want, dtypes)
+        if applied:
+            self.batcher.set_window(rec["window_s"])
+            self.metrics.counter(
+                "sort_serve_window_retunes_total").inc(1)
+            self.metrics.gauge("sort_serve_batch_window_ms").set(
+                rec["window_s"] * 1e3)
+            if missing:
+                # compile OFF the request path: a daemon thread pays
+                # the build (detached — see _build_detached: a racing
+                # cold-key get_packed may also compile, first insert
+                # wins, the dispatch thread never waits on prewarm)
+                def _prewarm(cache=self.cache, pairs=missing):
+                    for dn in sorted({d for _b, d in pairs}):
+                        cache.prewarm(tuple(sorted(
+                            b for b, d in pairs if d == dn)), (dn,))
+                threading.Thread(target=_prewarm,
+                                 name="serve-tuner-prewarm",
+                                 daemon=True).start()
+        if missing:
+            # its own plan event: a SortPlan keys decisions by name, so
+            # the bucket verdict cannot ride the window_auto record —
+            # and shadow logs the would-have-been prewarm too
+            bplan = plan_mod.SortPlan(algo="serve_tuner")
+            bplan.decide("planner", chosen="buckets_auto",
+                         trigger="mix_shift", applied=applied,
+                         buckets=sorted({int(b) for b, _d in missing}),
+                         dtypes=sorted({d for _b, d in missing}))
+            bplan.finalize()
+            self.tracer.spans.event("sort.plan", **bplan.to_attrs())
+        plan = plan_mod.SortPlan(algo="serve_tuner")
+        plan.decide("planner", chosen="window_auto",
+                    trigger="mix_shift", applied=applied,
+                    window_ms=round(rec["window_s"] * 1e3, 3),
+                    p50_gap_ms=round(rec["p50_gap_s"] * 1e3, 3),
+                    p99_n=rec["p99_n"],
+                    expected_batch_keys=rec["expected_batch_keys"])
+        plan.finalize()
+        self.tracer.spans.event("sort.plan", **plan.to_attrs())
 
     def stuck_trace_ids(self) -> list[str]:
         """Trace ids of requests admitted+dispatched but not yet

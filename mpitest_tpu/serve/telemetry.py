@@ -260,8 +260,16 @@ class _Handler(BaseHTTPRequestHandler):
                                 "dumps": rec.dumps,
                                 "dir": rec.directory},
             # rolling decision snapshot (ISSUE 12), fed from the ring —
-            # the traffic profile the self-tuning planner will consume
+            # the traffic profile the self-tuning planner consumes
             "plans": plan_snapshot(rec.snapshot()),
+            # self-tuning planner state (ISSUE 14): mode + the serve
+            # tuner's rolling-mix view and retune history
+            "planner": {
+                "mode": core.planner_mode,
+                "tuner": (core.tuner.snapshot()
+                          if core.tuner is not None else None),
+                "window_retunes": core.batcher.window_retunes,
+            },
             "profiler": core.profiler.state(),
             "requests": {"ok": core.requests_ok,
                          "err": core.requests_err},

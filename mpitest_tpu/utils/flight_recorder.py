@@ -62,23 +62,44 @@ class FlightRecorder:
         self.recorded = 0
         self._seq = 0
         self._last_dump: dict[str, float] = {}
-        self._lock = threading.Lock()
+        # reentrant: dump() snapshots while holding it (rate-limit +
+        # ring copy must be one atomic decision)
+        self._lock = threading.RLock()
 
     @property
     def enabled(self) -> bool:
         return self.capacity > 0
 
     def add(self, span: "Span") -> None:
-        """Hot path: one deque append (O(1), GIL-atomic)."""
+        """Hot path: one deque append under the ring lock.  The lock
+        matters: ``list(deque)`` in a concurrent :meth:`snapshot`
+        raises ``RuntimeError: deque mutated during iteration`` against
+        a bare append — the planner reads this ring from handler
+        threads while every span close appends (ISSUE 14), so both
+        sides serialize on the same lock (an uncontended acquire is
+        noise next to the span's own JSON encode)."""
         if self.capacity > 0:
-            self.ring.append(span)
-            self.recorded += 1
+            with self._lock:
+                self.ring.append(span)
+                self.recorded += 1
 
-    def snapshot(self) -> list[dict]:
-        """The ring as span dicts, parent links sanitized: a parent the
-        ring evicted becomes ``None`` so the snapshot passes
-        ``report.py --check`` (dangling parents are schema errors)."""
-        spans = list(self.ring)
+    def snapshot(self, last_n: int | None = None,
+                 kinds: "tuple[str, ...] | None" = None) -> list[dict]:
+        """The ring as span dicts — the bounded, lock-consistent read
+        API (ISSUE 14: the planner's data source; callers must never
+        iterate the deque raw against concurrent appends).  ``kinds``
+        filters by span name (e.g. ``("sort.plan",)``); ``last_n``
+        keeps only the newest N rows AFTER filtering.  Parent links are
+        sanitized: a parent the ring evicted (or the filter dropped)
+        becomes ``None`` so the snapshot passes ``report.py --check``
+        (dangling parents are schema errors)."""
+        with self._lock:
+            spans = list(self.ring)
+        if kinds is not None:
+            want = frozenset(kinds)
+            spans = [s for s in spans if getattr(s, "name", None) in want]
+        if last_n is not None and last_n >= 0:
+            spans = spans[-last_n:] if last_n else []
         dicts = [s.to_dict() for s in spans]
         present = {(d.get("pid"), d.get("id")) for d in dicts}
         for d in dicts:

@@ -382,6 +382,41 @@ register("SORT_PLAN", "enum", "on", "on | off",
          "spans and the plan-regret metrics (off = PR 8 behavior).",
          _enum("SORT_PLAN", ("on", "off")))
 
+# Self-tuning planner (ISSUE 14): the policy layer that acts on the
+# plan telemetry — per-request algo/cap-margin policy, serve-side
+# window/bucket auto-tuning, shadow/canary evaluation.
+
+
+def _parse_hysteresis(raw: str) -> float:
+    try:
+        v = float(raw)
+    except ValueError:
+        v = 0.0
+    if not math.isfinite(v) or v <= 1.0:
+        raise KnobError(f"SORT_PLANNER_HYSTERESIS={raw!r}: use a finite "
+                        "number > 1")
+    return v
+
+
+register("SORT_PLANNER", "enum", "off", "off | shadow | on",
+         "Self-tuning planner: off = hand-set defaults (byte-identical "
+         "pre-planner stack), shadow = compute + log every policy "
+         "choice without acting, on = act (models/planner.py).",
+         _enum("SORT_PLANNER", ("off", "shadow", "on")))
+register("SORT_PLANNER_WINDOW", "int", 256, "an integer >= 16",
+         "Rolling look-back of the planner's learning policies: flight-"
+         "ring plan records (cap margin) / request arrivals (serve "
+         "tuner).",
+         # 16 = planner.MIN_OBSERVATIONS: the serve tuner declines to
+         # recommend below it, so a smaller window would validate but
+         # silently behave as 16 — fail fast instead
+         _int("SORT_PLANNER_WINDOW", lo=16))
+register("SORT_PLANNER_HYSTERESIS", "float", 1.5, "a finite number > 1",
+         "Minimum up/down ratio a serve-tuner recommendation must "
+         "differ by before it may commit (two consecutive agreeing "
+         "evaluations required — the window never thrashes).",
+         _parse_hysteresis)
+
 # Observability sidecar paths (off when unset — the byte-compatible CLI
 # contract is untouched by default).
 register("SORT_TRACE", "path", None, "a writable file path",
@@ -689,6 +724,10 @@ register("BENCH_SERVE", "enum", "auto", "auto | off",
          "Emit the sort-as-a-service bench row (bench/serve_load.py "
          "against a spawned server).",
          _enum("BENCH_SERVE", ("auto", "off")))
+register("BENCH_PLANNER", "enum", "auto", "auto | off",
+         "Emit the planner_mix_mkeys_per_s bench row (the adversarial "
+         "mix of bench/planner_selftest.py, planner pinned off).",
+         _enum("BENCH_PLANNER", ("auto", "off")))
 
 # Bench-script knobs (bench/*.py probes and batteries).
 

@@ -142,6 +142,23 @@ METRICS: dict[str, tuple[str, str]] = {
     "sort_plan_reroutes_total": (
         "counter", "Plans whose algorithm was rerouted away from the "
                    "requested one (label: trigger)."),
+    # self-tuning planner (ISSUE 14): the policy layer's own telemetry
+    # — a bad policy is visible here before it costs throughput.
+    "sort_planner_decisions_total": (
+        "counter", "Planner policy decisions (labels: policy, "
+                   "applied) — shadow decisions count with "
+                   "applied=\"false\"."),
+    "sort_planner_regret": (
+        "gauge", "Last plan's planner-decision regret (the planner's "
+                 "own cost: wasted passthrough verifies) — rises when "
+                 "the policy chooses worse than the best-known "
+                 "config."),
+    "sort_serve_window_retunes_total": (
+        "counter", "Serve batching-window re-sizes the tuner applied "
+                   "(on mode; hysteresis-gated)."),
+    "sort_serve_batch_window_ms": (
+        "gauge", "Current (possibly auto-tuned) serve batching window "
+                 "in milliseconds."),
 }
 
 _HISTOGRAM_BUCKETS: dict[str, tuple[float, ...]] = {
@@ -517,6 +534,18 @@ class SpanMetricsBridge:
                         algo_d.get("chosen") != algo_d.get("requested"):
                     metrics.counter("sort_plan_reroutes_total").inc(
                         1, trigger=str(algo_d.get("trigger", "?")))
+                pl = decisions.get("planner")
+                if isinstance(pl, dict):
+                    # ISSUE 14: the planner's own census + regret —
+                    # `applied` distinguishes acting from shadow
+                    applied = bool((pl.get("predicted") or {})
+                                   .get("applied"))
+                    metrics.counter("sort_planner_decisions_total").inc(
+                        1, policy=str(pl.get("chosen", "?")),
+                        applied=str(applied).lower())
+                    if pl.get("regret") is not None:
+                        metrics.gauge("sort_planner_regret").set(
+                            float(pl["regret"]))
         elif name == "exchange_balance":
             for key, metric in (
                     ("recv_ratio", "sort_exchange_recv_ratio"),

@@ -69,15 +69,19 @@ def test_bench_canonical_host_provenance_gate(monkeypatch, capsys, mesh8):
 
     from mpitest_tpu.utils import knobs
 
-    # bench.main() pins SORT_FALLBACK=0 / SORT_MAX_RETRIES=0 via
+    # bench.main() pins SORT_FALLBACK=0 / SORT_MAX_RETRIES=0 /
+    # SORT_EXCHANGE_ENGINE=lax / SORT_PLANNER=off via
     # os.environ.setdefault — correct for its normal subprocess life,
     # but an IN-PROCESS call here would leak the pins into every later
     # test in the suite (observed: the whole supervisor-ladder family
-    # failing "retry budget exhausted" in full runs while passing
+    # failing "retry budget exhausted", and the exchange-engine knob
+    # test seeing default "lax", in full runs while passing
     # standalone).  scoped_env restores the pre-call state.
+    _BENCH_PINS = dict(SORT_FALLBACK=None, SORT_MAX_RETRIES=None,
+                       SORT_EXCHANGE_ENGINE=None, SORT_PLANNER=None)
     monkeypatch.setitem(bench.CANONICAL_NATIVE_MKEYS, key,
                         {"mkeys": 1.0, "host": "someone-elses-box/64c"})
-    with knobs.scoped_env(SORT_FALLBACK=None, SORT_MAX_RETRIES=None):
+    with knobs.scoped_env(**_BENCH_PINS):
         bench.main()
     row = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert "vs_canonical_native" not in row
@@ -85,7 +89,7 @@ def test_bench_canonical_host_provenance_gate(monkeypatch, capsys, mesh8):
 
     monkeypatch.setitem(bench.CANONICAL_NATIVE_MKEYS, key,
                         {"mkeys": 1.0, "host": host_fingerprint()})
-    with knobs.scoped_env(SORT_FALLBACK=None, SORT_MAX_RETRIES=None):
+    with knobs.scoped_env(**_BENCH_PINS):
         bench.main()
     row = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert row["vs_canonical_native"] > 0

@@ -1,0 +1,547 @@
+"""Self-tuning planner (ISSUE 14): policy scoring units, the learned
+cap-margin quantiles, serve-tuner hysteresis (an oscillating mix never
+flips the window twice in a row), shadow-mode byte identity, the
+verify-passthrough rung (hit AND miss), ladder recovery when a planner
+choice faults, the flight-recorder snapshot API, and knob validation.
+
+Uses the session-wide virtual 8-device CPU mesh from conftest.py.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+from mpitest_tpu.models import plan as plan_mod  # noqa: E402
+from mpitest_tpu.models import planner as planner_mod  # noqa: E402
+from mpitest_tpu.models.api import sort  # noqa: E402
+from mpitest_tpu.utils import flight_recorder, knobs  # noqa: E402
+from mpitest_tpu.utils.trace import Tracer  # noqa: E402
+
+
+def run_sort(x, algo="radix", mesh=None, **env):
+    tracer = Tracer()
+    with knobs.scoped_env(**env):
+        out = sort(x, algorithm=algo, mesh=mesh, tracer=tracer)
+    return out, tracer
+
+
+def near_sorted(n: int, runs: int = 32, seed: int = 0) -> np.ndarray:
+    """Overlapping ascending runs: ~runs/1024 of the strided profile's
+    adjacent sample pairs decrease — near-sorted, never fully sorted."""
+    rng = np.random.default_rng(seed)
+    span = (1 << 31) // runs
+    base = np.repeat(np.arange(runs, dtype=np.int64) * span, n // runs)
+    # sort PER RUN (axis=1): run i ascends over [i*span, (i+2)*span) —
+    # a global sort here would make the whole array sorted
+    off = np.sort(rng.integers(0, 2 * span, size=(runs, n // runs)),
+                  axis=1).reshape(-1)
+    return (base + off - (1 << 30)).astype(np.int32)
+
+
+# ------------------------------------------------- policy scoring units
+
+def test_choose_sorted_profile_is_passthrough():
+    c = planner_mod.choose({"sortedness": 1.0, "dup_ratio": 0.0},
+                           "radix", verify_on=True)
+    assert c.policy == "verify_passthrough"
+    assert c.trigger == "sorted"
+    assert c.algo is None  # a miss falls through to the requested algo
+
+
+def test_choose_sorted_without_verifier_never_skips_the_sort():
+    # the verifier is the passthrough's proof; without it the profile
+    # is a guess, and the scorer must fall through (here: merge_sample)
+    c = planner_mod.choose({"sortedness": 1.0, "dup_ratio": 0.0},
+                           "radix", verify_on=False)
+    assert c.policy != "verify_passthrough"
+
+
+def test_choose_dup_heavy_beats_near_sorted():
+    # a dup-heavy near-sorted input would degenerate sample splitters:
+    # the duplicate check must outrank the near-sorted one
+    c = planner_mod.choose({"sortedness": 0.95, "dup_ratio": 0.6},
+                           "sample", verify_on=True)
+    assert c.policy == "radix_narrow"
+    assert c.algo == "radix"
+
+
+def test_choose_near_sorted_is_merge_sample():
+    c = planner_mod.choose({"sortedness": 0.95, "dup_ratio": 0.01},
+                           "radix", verify_on=True)
+    assert c.policy == "merge_sample"
+    assert c.algo == "sample"
+
+
+def test_choose_uniform_and_empty_profiles_are_static():
+    assert planner_mod.choose({"sortedness": 0.5, "dup_ratio": 0.0},
+                              "radix", verify_on=True).policy == "static"
+    c = planner_mod.choose({}, "radix", verify_on=True)
+    assert c.policy == "static"
+    assert c.trigger == "no_profile"
+
+
+def test_policy_registry_lookup():
+    assert planner_mod.policy("static")
+    assert all(doc for doc in planner_mod.PLANNER_POLICIES.values())
+    with pytest.raises(KeyError):
+        planner_mod.policy("warp_speed")
+
+
+# --------------------------------------------------- learned cap margin
+
+class _FakeSpan:
+    def __init__(self, seq: int, name: str, attrs: dict) -> None:
+        self.name = name
+        self._d = {"pid": 1, "id": seq, "parent": None, "name": name,
+                   "attrs": attrs}
+
+    def to_dict(self) -> dict:
+        return dict(self._d)
+
+
+def _estimate_plan_span(seq: int, pred_need: float,
+                        actual_need: float) -> _FakeSpan:
+    return _FakeSpan(seq, "sort.plan", {
+        "decisions": {"cap": {"trigger": "estimate",
+                              "predicted": {"need": pred_need},
+                              "actual": {"need": actual_need}}}})
+
+
+@pytest.fixture
+def ring(monkeypatch):
+    rec = flight_recorder.FlightRecorder(256, "/tmp")
+    monkeypatch.setattr(flight_recorder, "get", lambda: rec)
+    return rec
+
+
+def test_learned_margin_needs_enough_samples(ring):
+    for i in range(planner_mod.MARGIN_MIN_SAMPLES - 1):
+        ring.add(_estimate_plan_span(i, 100, 104))
+    m, ev = planner_mod.learned_margin(1.25)
+    assert m == 1.25
+    assert ev["margin_learned"] is False
+
+
+def test_learned_margin_sizes_from_observed_quantiles(ring):
+    # 20 estimate decisions with error ratios 1.00..1.09: the learned
+    # margin lands near q95*pad — far below the hand-set 1.25
+    for i in range(20):
+        ring.add(_estimate_plan_span(i, 1000, 1000 + 5 * (i % 10)))
+    m, ev = planner_mod.learned_margin(1.25)
+    assert ev["margin_learned"] is True
+    assert ev["margin_samples"] == 20
+    assert planner_mod.MARGIN_MIN <= m < 1.25
+
+
+def test_learned_margin_clamps_a_wild_estimator(ring):
+    for i in range(10):
+        ring.add(_estimate_plan_span(i, 100, 500))
+    m, _ev = planner_mod.learned_margin(1.25)
+    assert m == planner_mod.MARGIN_MAX
+
+
+def test_learned_margin_memoizes_until_ring_grows(ring):
+    """The per-request ring scan is amortized: the learned value only
+    refreshes after MARGIN_REFRESH new spans land in the ring (or the
+    recorder instance changes — which is how each test's fresh ring
+    gets a fresh computation)."""
+    for i in range(20):
+        ring.add(_estimate_plan_span(i, 1000, 1100))
+    m1, ev1 = planner_mod.learned_margin(1.25)
+    assert ev1["margin_learned"] is True
+    # one wild new row, under the refresh threshold: memo hit
+    ring.add(_estimate_plan_span(100, 1000, 5000))
+    m2, _ = planner_mod.learned_margin(1.25)
+    assert m2 == m1
+    # past the threshold: recomputed, the spike is visible
+    for i in range(planner_mod.MARGIN_REFRESH):
+        ring.add(_estimate_plan_span(200 + i, 1000, 5000))
+    m3, _ = planner_mod.learned_margin(1.25)
+    assert m3 == planner_mod.MARGIN_MAX
+
+
+def test_learned_margin_ignores_exact_and_garbage_rows(ring):
+    ring.add(_FakeSpan(0, "sort.plan", {"decisions": {"cap": {
+        "trigger": "exact", "predicted": {"need": 10},
+        "actual": {"need": 99}}}}))
+    ring.add(_FakeSpan(1, "sort.plan", {"decisions": "nope"}))
+    ring.add(_FakeSpan(2, "verify", {}))
+    m, ev = planner_mod.learned_margin(1.25)
+    assert m == 1.25 and ev["margin_samples"] == 0
+
+
+# ------------------------------------------------- serve-tuner hysteresis
+
+def _feed(tuner, gap_s: float, n: int = 256, count: int = 24,
+          t0: float = 0.0) -> float:
+    t = t0
+    for _ in range(count):
+        tuner.observe(t, n)
+        t += gap_s
+    return t
+
+
+def test_tuner_recommends_from_interarrival_gaps():
+    tuner = planner_mod.ServeTuner(window=32, hysteresis=1.5,
+                                   batch_keys=1 << 16,
+                                   initial_window_s=1e-3)
+    _feed(tuner, 2e-3)
+    verdict = tuner.evaluate()
+    assert verdict is not None
+    _action, rec = verdict
+    assert rec["window_s"] == pytest.approx(
+        planner_mod.WINDOW_GAIN * 2e-3, rel=0.01)
+    assert rec["p99_n"] == 256
+
+
+def test_tuner_clamps_p99_to_batch_keys():
+    """Over-batch_keys requests dispatch solo and never use a packed
+    executable — their sizes must not steer bucket prewarm toward
+    shapes no batch can ever select."""
+    tuner = planner_mod.ServeTuner(window=32, hysteresis=1.5,
+                                   batch_keys=1024,
+                                   initial_window_s=1e-3)
+    _feed(tuner, 2e-3, n=10_000_000)
+    verdict = tuner.evaluate()
+    assert verdict is not None
+    rec = verdict[1]
+    assert rec["p99_n"] == 1024
+    assert rec["expected_batch_keys"] <= 1024
+
+
+def test_tuner_commits_only_after_two_agreeing_evaluations():
+    tuner = planner_mod.ServeTuner(window=32, hysteresis=1.5,
+                                   batch_keys=1 << 16,
+                                   initial_window_s=1e-3)
+    t = _feed(tuner, 2e-3)
+    a1 = tuner.evaluate()
+    assert a1 is not None and a1[0] == "hold"      # phase one: armed
+    assert tuner.window_s == 1e-3                  # nothing applied yet
+    _feed(tuner, 2e-3, t0=t)
+    a2 = tuner.evaluate()
+    assert a2 is not None and a2[0] == "retune"    # phase two: commit
+    assert tuner.window_s == pytest.approx(8e-3, rel=0.01)
+    assert tuner.retunes == 1
+
+
+def test_tuner_holds_inside_the_hysteresis_band():
+    tuner = planner_mod.ServeTuner(window=32, hysteresis=1.5,
+                                   batch_keys=1 << 16,
+                                   initial_window_s=7e-3)
+    t = _feed(tuner, 2e-3)          # desired 8 ms vs current 7 ms
+    for _ in range(3):
+        v = tuner.evaluate()
+        assert v is not None and v[0] == "hold"
+        t = _feed(tuner, 2e-3, t0=t)
+    assert tuner.retunes == 0
+
+
+def test_tuner_oscillating_mix_never_flips_twice_in_a_row():
+    """The hysteresis regression contract: alternating bursty/sparse
+    evaluations disagree in direction every time, so the window NEVER
+    commits; and after any commit the immediately-following evaluation
+    cannot commit again (two agreeing evaluations are required)."""
+    tuner = planner_mod.ServeTuner(window=24, hysteresis=1.5,
+                                   batch_keys=1 << 16,
+                                   initial_window_s=4e-3)
+    t = 0.0
+    for i in range(8):
+        t = _feed(tuner, 0.5e-3 if i % 2 == 0 else 3.5e-3, t0=t)
+        v = tuner.evaluate()
+        assert v is not None and v[0] == "hold"
+    assert tuner.retunes == 0
+    # an in-band evaluation clears the armed direction the loop left
+    t = _feed(tuner, 1e-3, t0=t)
+    assert tuner.evaluate()[0] == "hold"
+    # now converge (two agreeing evals commit once) ...
+    t = _feed(tuner, 3.5e-3, t0=t)
+    assert tuner.evaluate()[0] == "hold"
+    t = _feed(tuner, 3.5e-3, t0=t)
+    assert tuner.evaluate()[0] == "retune"
+    # ... and the very next evaluation, even wildly out of band the
+    # OTHER way, may only arm — never a second consecutive flip
+    t = _feed(tuner, 0.25e-3, t0=t)
+    assert tuner.evaluate()[0] == "hold"
+    assert tuner.retunes == 1
+
+
+def test_tuner_snapshot_is_json_shaped():
+    tuner = planner_mod.ServeTuner(window=32, hysteresis=1.5,
+                                   batch_keys=1 << 16,
+                                   initial_window_s=1e-3)
+    snap = tuner.snapshot()
+    assert snap["retunes"] == 0 and snap["observations"] == 0
+    assert snap["hysteresis"] == 1.5
+
+
+# ------------------------------------- end-to-end: shadow / on (mesh8)
+
+def test_shadow_is_byte_identical_and_logs_decisions(mesh8, rng):
+    x = rng.integers(-2**31, 2**31 - 1, size=1 << 13, dtype=np.int32)
+    out_off, tr_off = run_sort(x, algo="sample", mesh=mesh8,
+                               SORT_PLANNER="off")
+    out_sh, tr_sh = run_sort(x, algo="sample", mesh=mesh8,
+                             SORT_PLANNER="shadow")
+    assert out_off.tobytes() == out_sh.tobytes()
+    assert "planner" not in tr_off.plan.decisions
+    d = tr_sh.plan.decisions["planner"]
+    assert d.predicted["applied"] is False
+    assert d.chosen in planner_mod.PLANNER_POLICIES
+    assert tr_sh.counters["planner"] == "shadow"
+
+
+def test_passthrough_sorts_a_sorted_input_with_one_verify(mesh8):
+    x = np.arange(-4096, 4096, dtype=np.int32)
+    out, tr = run_sort(x, algo="radix", mesh=mesh8, SORT_PLANNER="on")
+    assert np.array_equal(out, x)
+    assert tr.counters["planner_passthrough"] == 1
+    p = tr.plan
+    assert p.decisions["planner"].chosen == "verify_passthrough"
+    assert p.decisions["ladder"].chosen == "passthrough"
+    assert p.decisions["planner"].regret == 0.0
+    # no exchange ever ran: the probe/negotiation machinery was skipped
+    assert "exchange_cap" not in tr.counters
+
+
+def test_passthrough_miss_falls_through_to_a_real_sort(mesh8):
+    # one local inversion hidden between the profile's strided samples:
+    # the scorer reads sorted, the verifier says no, the ladder sorts
+    x = np.arange(1 << 13, dtype=np.int32)
+    x[5], x[6] = x[6], x[5]
+    assert planner_mod.choose(
+        plan_mod.profile_host_array(x), "radix",
+        verify_on=True).policy == "verify_passthrough"
+    out, tr = run_sort(x, algo="radix", mesh=mesh8, SORT_PLANNER="on")
+    assert np.array_equal(out, np.sort(x))
+    assert tr.counters["planner_passthrough_miss"] == 1
+    assert "planner_passthrough" not in tr.counters
+    d = tr.plan.decisions["planner"]
+    assert d.actual["misses"] == 1
+    assert d.regret == 1.0  # the wasted verify is the planner's cost
+
+
+def test_planner_reroutes_near_sorted_to_sample(mesh8):
+    x = near_sorted(1 << 13)
+    out, tr = run_sort(x, algo="radix", mesh=mesh8, SORT_PLANNER="on")
+    assert np.array_equal(out, np.sort(x))
+    p = tr.plan
+    assert p.decisions["planner"].chosen == "merge_sample"
+    assert p.decisions["algo"].chosen == "sample"
+    assert p.decisions["algo"].requested == "radix"
+    assert p.decisions["algo"].trigger == "planner"
+    assert p.algo == "sample"
+
+
+def test_planner_off_requires_plan_provenance(mesh8, rng):
+    # the planner rides the plan record: SORT_PLAN=off disables it too
+    x = rng.integers(-2**31, 2**31 - 1, size=4096, dtype=np.int32)
+    out, tr = run_sort(x, mesh=mesh8, SORT_PLANNER="on", SORT_PLAN="off")
+    assert np.array_equal(out, np.sort(x))
+    assert tr.plan is None
+    assert "planner" not in tr.counters
+
+
+def test_learned_margin_is_wired_into_the_cap_decision(mesh8, rng,
+                                                       monkeypatch):
+    monkeypatch.setattr(planner_mod, "learned_margin",
+                        lambda default, last_n=None:
+                        (1.05, {"margin_samples": 20,
+                                "margin_learned": True}))
+    x = rng.integers(-2**31, 2**31 - 1, size=1 << 13, dtype=np.int32)
+    out, tr = run_sort(x, algo="sample", mesh=mesh8, SORT_PLANNER="on")
+    assert np.array_equal(out, np.sort(x))
+    cap = tr.plan.decisions["cap"]
+    assert cap.trigger == "estimate"
+    assert cap.predicted["margin"] == 1.05
+    assert tr.plan.decisions["planner"].predicted["margin"] == 1.05
+
+
+def test_ladder_recovers_when_a_planner_choice_faults(mesh8):
+    """A planner-chosen path that faults at dispatch must recover
+    through the ordinary supervisor machinery — the planner may only
+    choose among recoverable paths."""
+    x = near_sorted(1 << 13, seed=3)
+    out, tr = run_sort(x, algo="radix", mesh=mesh8, SORT_PLANNER="on",
+                       SORT_FAULTS="dispatch_error:1",
+                       SORT_MAX_RETRIES="2")
+    assert np.array_equal(out, np.sort(x))
+    p = tr.plan
+    assert p.decisions["planner"].chosen == "merge_sample"
+    assert p.decisions["ladder"].actual.get("dispatch_retries", 0) >= 1
+    assert tr.counters.get("sort_retries", 0) >= 1
+
+
+# -------------------------------------------- serve tuner wiring (core)
+
+def _mk_core(mesh, mode: str):
+    from mpitest_tpu.serve.server import ServerCore
+
+    with knobs.scoped_env(SORT_PLANNER=mode,
+                          SORT_SERVE_BATCH_WINDOW_MS="1"):
+        return ServerCore(mesh=mesh)
+
+
+def test_server_tuner_applies_only_in_on_mode(mesh8, monkeypatch):
+    rec = {"window_s": 0.008, "p50_gap_s": 0.002, "p99_n": 512,
+           "expected_batch_keys": 2048}
+    for mode, applied in (("on", True), ("shadow", False)):
+        core = _mk_core(mesh8, mode)
+        try:
+            assert core.tuner is not None
+            monkeypatch.setattr(core.tuner, "observe",
+                                lambda t, n, dt="int32": True)
+            monkeypatch.setattr(core.tuner, "evaluate",
+                                lambda: ("retune", dict(rec)))
+            # no background AOT compiles in a unit test — the spawn
+            # itself (applied mode + missing buckets) is the behavior
+            monkeypatch.setattr(core.cache, "prewarm",
+                                lambda *a, **k: 0)
+            before = core.batcher.window_s
+            core._tuner_observe(512)
+            if applied:
+                assert core.batcher.window_s == pytest.approx(0.008)
+                assert core.batcher.window_retunes == 1
+            else:
+                assert core.batcher.window_s == before
+                assert core.batcher.window_retunes == 0
+            # both modes record the registered planner decisions —
+            # window_auto always, buckets_auto when the mix's buckets
+            # are not yet compiled (a fresh core's cache is empty)
+            ds = [s.attrs["decisions"]["planner"]
+                  for s in core.tracer.spans.spans
+                  if s.name == "sort.plan"
+                  and (s.attrs.get("decisions") or {}).get("planner")]
+            by = {d["chosen"]: d for d in ds}
+            assert "window_auto" in by, "no window_auto decision"
+            assert by["window_auto"]["predicted"]["applied"] is applied
+            assert "buckets_auto" in by, "no buckets_auto decision"
+            assert by["buckets_auto"]["predicted"]["applied"] is applied
+            assert by["buckets_auto"]["predicted"]["buckets"]
+        finally:
+            core.batcher.stop(timeout=10.0)
+
+
+def test_server_without_planner_has_no_tuner(mesh8):
+    core = _mk_core(mesh8, "off")
+    try:
+        assert core.tuner is None
+        core._tuner_observe(256)  # must be a no-op, never a crash
+    finally:
+        core.batcher.stop(timeout=10.0)
+
+
+def test_server_solo_window_disables_tuner(mesh8):
+    """An operator-configured solo-dispatch server (window 0) has no
+    batching window to tune — SORT_PLANNER=on must never convert it
+    into a batching server (the tuner's clamp floor could only ever
+    override that explicit config, never restore it)."""
+    from mpitest_tpu.serve.server import ServerCore
+
+    with knobs.scoped_env(SORT_PLANNER="on",
+                          SORT_SERVE_BATCH_WINDOW_MS="0"):
+        core = ServerCore(mesh=mesh8)
+    try:
+        assert core.tuner is None
+        core._tuner_observe(256)
+        assert core.batcher.window_s == 0.0
+        assert core.batcher.window_retunes == 0
+    finally:
+        core.batcher.stop(timeout=10.0)
+
+
+# ------------------------------------- flight-recorder snapshot (ISSUE 14)
+
+def test_snapshot_kinds_and_last_n_filtering():
+    rec = flight_recorder.FlightRecorder(128, "/tmp")
+    for i in range(20):
+        rec.add(_FakeSpan(i, "sort.plan" if i % 2 == 0 else "verify",
+                          {"i": i}))
+    assert len(rec.snapshot()) == 20
+    plans = rec.snapshot(kinds=("sort.plan",))
+    assert len(plans) == 10
+    assert all(d["name"] == "sort.plan" for d in plans)
+    last = rec.snapshot(last_n=3, kinds=("sort.plan",))
+    assert [d["attrs"]["i"] for d in last] == [14, 16, 18]
+    assert rec.snapshot(last_n=0) == []
+
+
+def test_snapshot_bounded_by_ring_capacity():
+    rec = flight_recorder.FlightRecorder(8, "/tmp")
+    for i in range(50):
+        rec.add(_FakeSpan(i, "verify", {"i": i}))
+    rows = rec.snapshot()
+    assert len(rows) == 8
+    assert [d["attrs"]["i"] for d in rows] == list(range(42, 50))
+
+
+def test_snapshot_consistent_under_concurrent_append():
+    """The satellite regression: snapshot() while another thread
+    hammers add() must never raise (a raw ``list(deque)`` against a
+    concurrent append raises ``deque mutated during iteration``) and
+    every snapshot stays within capacity."""
+    rec = flight_recorder.FlightRecorder(64, "/tmp")
+    stop = threading.Event()
+    errors: list[BaseException] = []
+
+    def writer() -> None:
+        i = 0
+        try:
+            while not stop.is_set():
+                rec.add(_FakeSpan(i, "verify", {"i": i}))
+                i += 1
+        except BaseException as e:  # noqa: BLE001 — the assertion
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer) for _ in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(300):
+            rows = rec.snapshot(last_n=32, kinds=("verify",))
+            assert len(rows) <= 32
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+    assert not errors, f"writer raised: {errors[0]!r}"
+
+
+# ------------------------------------------------------ knob validation
+
+def test_planner_knob_validation():
+    with knobs.scoped_env(SORT_PLANNER="warp"):
+        with pytest.raises(ValueError, match="SORT_PLANNER"):
+            knobs.get("SORT_PLANNER")
+    with knobs.scoped_env(SORT_PLANNER_WINDOW="4"):
+        with pytest.raises(ValueError, match="SORT_PLANNER_WINDOW"):
+            knobs.get("SORT_PLANNER_WINDOW")
+    for bad in ("1.0", "0.5", "nan", "inf", "x"):
+        with knobs.scoped_env(SORT_PLANNER_HYSTERESIS=bad):
+            with pytest.raises(ValueError,
+                               match="SORT_PLANNER_HYSTERESIS"):
+                knobs.get("SORT_PLANNER_HYSTERESIS")
+    # defaults: planner off, sane learning window
+    assert knobs.get("SORT_PLANNER") == "off"
+    # floor == planner.MIN_OBSERVATIONS: a smaller window would
+    # validate but silently behave as 16 (the tuner's minimum)
+    assert knobs.get("SORT_PLANNER_WINDOW") >= planner_mod.MIN_OBSERVATIONS
+    assert knobs.get("SORT_PLANNER_HYSTERESIS") > 1.0
+
+
+def test_planner_knobs_in_driver_validate_lists():
+    """Both drivers fail fast on planner-knob garbage: the validate()
+    sweeps must name all three knobs (source-level pin, like the
+    exchange-engine knob's)."""
+    for driver in ("drivers/sort_cli.py", "drivers/sort_server.py"):
+        src = (REPO / driver).read_text()
+        for name in ("SORT_PLANNER", "SORT_PLANNER_WINDOW",
+                     "SORT_PLANNER_HYSTERESIS"):
+            assert f'"{name}"' in src, f"{driver} does not validate {name}"

@@ -119,12 +119,46 @@ def test_sl005_plan_decisions_come_from_registry():
     assert lint_source(bad, "mpitest_tpu/models/plan.py") == []
 
 
+def test_sl006_planner_policies_come_from_registry():
+    bad = "p = planner.policy('warp_speed')\n"
+    assert rules_of(lint_source(bad, "x.py")) == ["SL006"]
+    bad2 = "planner_mod.policy('made_up')\n"
+    assert rules_of(lint_source(bad2, "x.py")) == ["SL006"]
+    # a dynamic lookup is allowed: policy() raises KeyError on
+    # unregistered names at runtime — the call IS the registry check
+    nonlit = "planner.policy(name)\n"
+    assert lint_source(nonlit, "x.py") == []
+    # the recorded verdict is policed too: plan.decide("planner",
+    # chosen=...) must use a registered policy name
+    bad3 = "plan.decide('planner', chosen='warp_speed', applied=True)\n"
+    assert rules_of(lint_source(bad3, "x.py")) == ["SL006"]
+    good = ("p = planner.policy('verify_passthrough')\n"
+            "plan.decide('planner', chosen='window_auto', applied=True)\n"
+            "plan.decide('planner', chosen=pchoice.policy)\n")
+    assert lint_source(good, "x.py") == []
+    # unrelated receivers never match
+    unrelated = "cfg.policy('whatever')\n"
+    assert lint_source(unrelated, "x.py") == []
+    # the registry module itself is exempt
+    assert lint_source(bad, "mpitest_tpu/models/planner.py") == []
+
+
+def test_planner_registry_vocabulary():
+    from mpitest_tpu.models import planner as planner_mod
+
+    assert all(doc for doc in planner_mod.PLANNER_POLICIES.values())
+    for must in ("static", "verify_passthrough", "merge_sample",
+                 "radix_narrow", "cap_margin", "window_auto"):
+        assert must in planner_mod.PLANNER_POLICIES
+
+
 def test_plan_registry_vocabulary():
     from mpitest_tpu.models import plan as plan_mod
 
     assert all(doc for doc in plan_mod.PLAN_DECISIONS.values())
     assert {"algo", "cap", "restage", "engine", "exchange_engine",
-            "passes", "ladder", "batch"} == set(plan_mod.PLAN_DECISIONS)
+            "passes", "ladder", "batch",
+            "planner"} == set(plan_mod.PLAN_DECISIONS)
 
 
 def test_metrics_registry_vocabulary():

@@ -54,6 +54,9 @@ COLUMNS: tuple[tuple[str, str, str, bool], ...] = (
 #: jump that coincides with an engine flip is attribution, not noise).
 LABEL_COLUMNS: tuple[tuple[str, str], ...] = (
     ("exchange_engine", "engine"),
+    # ISSUE 14: the planner mode the row measured under — pinned "off"
+    # on measured rows via setdefault; pre-r06 rounds render "-".
+    ("planner", "planner"),
 )
 
 _RUN_RE = re.compile(r"BENCH_r(\d+)\.json$")
@@ -121,6 +124,9 @@ def load_run(path: Path) -> dict[str, object]:
                 # rounds predate the field and render "-")
                 if isinstance(obj.get("exchange_engine"), str):
                     labels["exchange_engine"] = obj["exchange_engine"]
+                # ISSUE 14: ditto the planner column
+                if isinstance(obj.get("planner"), str):
+                    labels["planner"] = obj["planner"]
     vals["_labels"] = labels  # type: ignore[assignment]
     # derived: end-to-end ratio when a round recorded both throughputs
     # but not the ratio itself (pre-ISSUE-6 rounds)

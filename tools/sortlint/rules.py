@@ -353,6 +353,99 @@ register(Rule(
     _check_plan_decision))
 
 
+# ---------------------------------------------------------------- SL006
+
+def _load_planner_schema() -> Any:
+    """models/planner.py by file path (stdlib-only at import by design,
+    like plan.py) — SL006 checks against the real PLANNER_POLICIES."""
+    import sys
+
+    path = REPO_ROOT / "mpitest_tpu" / "models" / "planner.py"
+    spec = importlib.util.spec_from_file_location("_sortlint_planner",
+                                                  path)
+    assert spec is not None and spec.loader is not None
+    mod = importlib.util.module_from_spec(spec)
+    # planner.py declares dataclasses — register before exec, like the
+    # plan.py loader above
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_PLANNER_MOD = _load_planner_schema()
+
+#: The module that IS the policy registry — the rule polices users.
+_PLANNER_EXEMPT = ("mpitest_tpu/models/planner.py",)
+
+#: Receiver names that denote the planner module / a tuner object.
+_PLANNER_BASES = ("planner", "planner_mod", "sort_planner", "tuner")
+
+
+def _check_planner_policy(path: str, src: str,
+                          tree: ast.AST) -> list[Finding]:
+    """SL006: literal planner policy names must come from the
+    registered ``PLANNER_POLICIES`` vocabulary (models/planner.py) —
+    both at the lookup (``planner.policy("x")``) and where a plan
+    records the planner verdict (``plan.decide("planner",
+    chosen="x")``).  An unregistered policy would vanish from the
+    explain census, the /metrics decision labels and the selftest's
+    policy accounting."""
+    if _ends(path, *_PLANNER_EXEMPT):
+        return []
+    out = []
+    for node, _ in _walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not isinstance(f, ast.Attribute):
+            continue
+        base = f.value
+        base_name = base.id if isinstance(base, ast.Name) else \
+            base.attr if isinstance(base, ast.Attribute) else ""
+        if f.attr == "policy" and base_name in _PLANNER_BASES \
+                and node.args:
+            first = node.args[0]
+            if isinstance(first, ast.Constant) \
+                    and isinstance(first.value, str):
+                if first.value not in _PLANNER_MOD.PLANNER_POLICIES:
+                    out.append(Finding(
+                        "SL006", path, node.lineno,
+                        f"planner policy {first.value!r} is not "
+                        "registered in models/planner.py "
+                        "PLANNER_POLICIES; register it there (the "
+                        "explain census, /metrics labels and the "
+                        "planner selftest key on these names)"))
+            # non-literal names are fine HERE: planner.policy() raises
+            # KeyError on unregistered names at runtime — the dynamic
+            # call IS the registry check this rule enforces statically
+            continue
+        # plan.decide("planner", chosen="<policy>"): the recorded
+        # verdict must use a registered policy name too
+        if f.attr == "decide" and base_name in _PLAN_BASES and node.args:
+            first = node.args[0]
+            if not (isinstance(first, ast.Constant)
+                    and first.value == "planner"):
+                continue
+            for kw in node.keywords:
+                if kw.arg == "chosen" and isinstance(kw.value, ast.Constant) \
+                        and isinstance(kw.value.value, str) \
+                        and kw.value.value not in \
+                        _PLANNER_MOD.PLANNER_POLICIES:
+                    out.append(Finding(
+                        "SL006", path, node.lineno,
+                        f"planner decision records unregistered policy "
+                        f"{kw.value.value!r}; register it in "
+                        "models/planner.py PLANNER_POLICIES"))
+    return out
+
+
+register(Rule(
+    "SL006", "planner-policy-registry",
+    "literal planner policy names must come from models/planner.py "
+    "PLANNER_POLICIES",
+    _check_planner_policy))
+
+
 # ------------------------------------------------------- SL010 / SL011 / SL012
 
 def _check_lax_reduce(path: str, src: str, tree: ast.AST) -> list[Finding]:

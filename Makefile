@@ -67,6 +67,16 @@
 #                  report.py --check) and recover via the breaker's
 #                  half-open probe; and hedging must cut the
 #                  injected-tail p99 strictly below the unhedged run.
+#   make external-selftest — the out-of-core gate (ISSUE 15): a
+#                  dataset 4x a forced SORT_MEM_BUDGET spills to
+#                  SORTBIN1-framed sorted runs and k-way merges back
+#                  bit-identical to the in-memory sort; key+payload
+#                  record parity vs the numpy stable argsort-gather
+#                  oracle across all dtypes; spill_corrupt/merge_drop
+#                  fault cells recover verified or fail typed; a
+#                  spawned server serves a payload_bytes request and
+#                  an over-admission request (via the spill tier) each
+#                  bit-identical to the solo in-memory oracle.
 #   make lint    — static analysis (ISSUE 4): sortlint (the project's
 #                  custom AST rules — env-knob registry, span schema,
 #                  SPMD safety, fault coverage, typed core), the
@@ -92,8 +102,9 @@ PYTHON ?= python3
 
 .PHONY: test native native-encode chip-test telemetry-selftest \
     ingest-selftest fault-selftest multichip-selftest serve-selftest \
-    chaos-serve-selftest planner-selftest lint cwarn-check typecheck \
-    tidy-check knob-docs sanitize-selftest bench-history clean
+    chaos-serve-selftest planner-selftest external-selftest lint \
+    cwarn-check typecheck tidy-check knob-docs sanitize-selftest \
+    bench-history clean
 
 chip-test:
 	$(PYTHON) -u bench/chip_regression.py
@@ -214,6 +225,23 @@ planner-selftest:
 	$(PYTHON) -m mpitest_tpu.report --check --require-registered-spans \
 	    $(PLANNER_TMP)/trace.jsonl
 	$(PYTHON) -m mpitest_tpu.report --explain $(PLANNER_TMP)/trace.jsonl
+
+# The out-of-core gate (ISSUE 15) — see bench/external_selftest.py.
+# A dataset 4x the forced SORT_MEM_BUDGET spills to sorted runs and
+# k-way merges back bit-identical to the in-memory sort; record
+# (key+payload) parity vs the numpy stable argsort-gather oracle across
+# all dtypes; spill_corrupt/merge_drop fault cells recover verified or
+# fail typed; and a spawned server proves payload_bytes requests plus
+# the over-admission spill tier end to end.  The final report pass
+# validates the emitted external.* spans against the registered schema.
+EXTERNAL_TMP := /tmp/mpitest_external_selftest
+external-selftest:
+	rm -rf $(EXTERNAL_TMP) && mkdir -p $(EXTERNAL_TMP)
+	JAX_PLATFORMS=cpu \
+	    SORT_TRACE=$(EXTERNAL_TMP)/trace.jsonl \
+	    $(PYTHON) -u bench/external_selftest.py
+	$(PYTHON) -m mpitest_tpu.report --check --require-registered-spans \
+	    $(EXTERNAL_TMP)/trace.jsonl
 
 # The wire-chaos gate (ISSUE 11) — see bench/chaos_serve_selftest.py.
 # Real servers behind the chaos TCP proxy on a plain 1-device CPU
@@ -393,4 +421,4 @@ clean:
 	$(MAKE) -C mpi_sample_sort clean
 	$(MAKE) -C mpi_radix_sort clean
 	$(MAKE) -C bench clean
-	rm -rf $(SAN_OUT)
+	rm -rf $(SAN_OUT) $(CURDIR)/bench/.spill-out

@@ -372,6 +372,31 @@ def _emit_planner_row() -> None:
         log(f"planner: skipped ({type(e).__name__}: {e})")
 
 
+def _emit_external_row() -> None:
+    """Fifth JSONL row (ISSUE 15): the out-of-core measurement —
+    ``bench/external_selftest.py --row`` externally sorts a dataset 4x
+    a forced ``SORT_MEM_BUDGET`` (spill runs + k-way merge, output
+    verified bit-identical in-process) and emits spill+merge Mkeys/s
+    with run count and disk bytes.  Best-effort by contract, its own
+    subprocess like the planner row."""
+    try:
+        r = subprocess.run(
+            [sys.executable,
+             str(REPO / "bench" / "external_selftest.py"), "--row"],
+            capture_output=True, text=True, timeout=1800)
+        for line in r.stderr.splitlines():
+            log(f"external| {line}")
+        rows = [ln for ln in r.stdout.splitlines() if ln.strip()]
+        if r.returncode != 0 or not rows:
+            log(f"external: row run failed (rc={r.returncode}); "
+                "omitting row")
+            return
+        row = json.loads(rows[-1])  # re-validate before re-emitting
+        print(json.dumps(row))
+    except Exception as e:  # noqa: BLE001 — the row is best-effort
+        log(f"external: skipped ({type(e).__name__}: {e})")
+
+
 def multichip_main() -> None:
     """``bench.py --multichip-row``: measure ONLY the devices=8 row (the
     subprocess side of :func:`_emit_multichip_row`)."""
@@ -845,6 +870,17 @@ def main() -> None:
         else:
             log(f"planner: skipped at 2^{log2n} (scale-gated; run "
                 "bench/planner_selftest.py --row directly)")
+
+    # Fifth JSONL row (ISSUE 15): the out-of-core measurement — the
+    # same dataset spilled + k-way-merged under a forced SORT_MEM_BUDGET
+    # far below its size (spill+merge throughput, run count, disk
+    # bytes).  Scale-gated like the serve/planner rows.
+    if knobs.get("BENCH_EXTERNAL") != "off":
+        if log2n >= 16:
+            _emit_external_row()
+        else:
+            log(f"external: skipped at 2^{log2n} (scale-gated; run "
+                "bench/external_selftest.py --row directly)")
 
 
 if __name__ == "__main__":

@@ -102,6 +102,10 @@ def main(argv: list[str] | None = None) -> int:
             # the serve window/bucket tuner
             "SORT_PLANNER", "SORT_PLANNER_WINDOW",
             "SORT_PLANNER_HYSTERESIS",
+            # out-of-core spill tier (ISSUE 15): over-budget requests
+            # stream to disk and ride the external sort
+            "SORT_SERVE_SPILL", "SORT_SPILL_DIR", "SORT_MEM_BUDGET",
+            "SORT_MERGE_FANIN",
         )
         from mpitest_tpu.utils import native_encode
 

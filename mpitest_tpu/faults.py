@@ -41,6 +41,11 @@ both algorithms):
                     (breaks sortedness — caught by the order check)
 ``result_dup``      overwrite key[1] with key[0] (stays sorted — caught
                     ONLY by the multiset fingerprint)
+``spill_corrupt``   flip bits in a spill run's on-disk keys AFTER the
+                    fingerprint sidecar folded them (store/runs.py —
+                    the external sort's bad-disk drill)
+``merge_drop``      drop one merged output chunk before the output fold
+                    (store/merge.py — silent merge truncation)
 ================  ==========================================================
 
 Wire-level chaos (ISSUE 11) is a separate family: :data:`WIRE_SITES`
@@ -87,6 +92,12 @@ SITES = (
     "ingest_poison",
     "result_swap",
     "result_dup",
+    # out-of-core external sort (ISSUE 15, mpitest_tpu/store/):
+    "spill_corrupt",   # flip bits in a spill run's on-disk keys AFTER
+                       # the fingerprint sidecar folded them — a bad
+                       # disk / torn write the merge must catch
+    "merge_drop",      # drop one merged output chunk before the output
+                       # fold — silent truncation in the merge engine
 )
 
 #: Sites applied at trace time inside the compiled SPMD program (the
@@ -449,6 +460,36 @@ def maybe_poison_chunk(words: tuple[np.ndarray, ...],
     if w0.size:
         w0[0] ^= word & 0xFFFFFFFF
     return (w0,) + tuple(words[1:])
+
+
+def maybe_corrupt_spill(raw: bytes) -> bytes:
+    """Spill-run hook (store/runs.py write path): corrupt the first key
+    bytes of a run AFTER its fingerprint sidecar folded the clean words
+    — the on-disk bytes then disagree with the sidecar, exactly the
+    torn-write/bit-rot shape the merge's read-back fold must flag."""
+    reg = current()
+    if reg is None or not reg.would_fire("spill_corrupt"):
+        return raw
+    word = reg.rand_word()
+    if not reg.fire("spill_corrupt", word=word):
+        return raw
+    buf = bytearray(raw)
+    if len(buf) >= 4:
+        for i in range(4):
+            buf[i] ^= (word >> (8 * i)) & 0xFF
+    return bytes(buf)
+
+
+def should_drop_merge_chunk(chunk_idx: int, n: int) -> bool:
+    """Merge hook (store/merge.py emit path): True when the armed
+    ``merge_drop`` site consumes this output chunk — the chunk vanishes
+    from the merged output AND its fold, so the external driver's
+    count/fingerprint comparison against the combined run sidecars must
+    trip (silent truncation made loud)."""
+    reg = current()
+    if reg is None or not reg.would_fire("merge_drop"):
+        return False
+    return reg.fire("merge_drop", chunk=chunk_idx, n=n)
 
 
 def maybe_corrupt_result(reg: FaultRegistry | None,

@@ -1069,9 +1069,20 @@ def sort(
     return_result: bool = False,
     pack: str | None = None,   # exchange pack impl; None = auto by backend
     exchange_engine: str | None = None,  # None = SORT_EXCHANGE_ENGINE knob
+    payload: Any = None,       # per-record payload bytes -> record sort
 ) -> Any:
     """Sort integer keys on the mesh; returns a sorted numpy array
     (or the device-resident :class:`DistributedSortResult`).
+
+    ``payload`` (ISSUE 15) turns the call into a **record sort**: each
+    key drags an opaque per-record payload (bytes / any fixed-itemsize
+    array, see :func:`models.records.as_payload_matrix`) that is
+    permuted alongside the keys by a device-side argsort-gather —
+    stable by key, verified end-to-end by the record fingerprint.  The
+    return value is then the ``(sorted_keys, sorted_payload)`` pair
+    (payload as an ``(n, width)`` uint8 matrix); ``return_result`` /
+    ``exchange_engine`` do not apply — records ride the fused local
+    program in :mod:`mpitest_tpu.models.records`.
 
     ``exchange_engine`` (ISSUE 13) selects the inter-device exchange
     path — ``lax`` (XLA collective) or ``pallas`` (remote-DMA kernel +
@@ -1103,6 +1114,21 @@ def sort(
     trace_path = knobs.get("SORT_TRACE")
     if trace_path and tracer.spans.stream_path is None:
         tracer.spans.stream_path = trace_path
+    if payload is not None:
+        # record sort (ISSUE 15): key+payload through the fused
+        # argsort-gather program — models/records.py owns the path,
+        # including its always-on record-fingerprint verification.
+        # The record path mints no plan record: clear any PREVIOUS
+        # run's plan from a reused tracer (the serve dispatch thread),
+        # or the reply digest would carry a stranger's decisions.
+        from mpitest_tpu.models import records
+
+        tracer.plan = None
+        arr = np.asarray(x)
+        with tracer.spans.span("sort", algorithm="records",
+                               n=int(arr.size), dtype=str(arr.dtype)):
+            return records.sort_records(arr, payload, mesh=mesh,
+                                        tracer=tracer)
     size = getattr(x, "size", None)
     # Fault registry for THIS run (SORT_FAULTS env or an installed test
     # registry) — active for the whole run so the ingest/exchange hooks

@@ -74,6 +74,12 @@ PLAN_DECISIONS: dict[str, str] = {
                 "only logged (shadow), the learned margin evidence; a "
                 "passthrough miss (the strided profile lied and the "
                 "verify pass was wasted) is this decision's regret"),
+    "external": ("out-of-core tier verdict (ISSUE 15): the request "
+                 "spilled to sorted runs + k-way merge under "
+                 "SORT_MEM_BUDGET (predicted budget/fan-in vs actual "
+                 "runs/disk bytes/merge passes); each integrity "
+                 "recovery — a re-spilled run + re-merge — is this "
+                 "decision's regret"),
 }
 
 #: Registered input-distribution profile fields (the probe-riding
@@ -272,6 +278,10 @@ class SortPlan:
             # shadow decision (applied False) changed nothing and can
             # regret nothing.
             return float(a.get("misses", 0) or 0)
+        if d.name == "external":
+            # each recovery paid one blamed-run re-spill + a full
+            # re-merge before the verified result
+            return float(a.get("recoveries", 0) or 0)
         if d.name == "exchange_engine":
             # either degrade cause paid every dispatch up to the switch
             # before the lax rung re-ran the whole algorithm; the
@@ -342,6 +352,12 @@ class SortPlan:
             # (and the serve_load plan fold) see policy drift directly
             out["planner"] = _scalar(pl.chosen)
             out["planner_regret"] = pl.regret
+        ext = self.decisions.get("external")
+        if ext is not None:
+            # ISSUE 15: the typed evidence an over-budget request was
+            # served by the spill tier, not rejected
+            out["spilled"] = True
+            out["spill_runs"] = _scalar(ext.actual.get("runs"))
         return out
 
 

@@ -81,6 +81,41 @@ def fingerprint_host(words: "tuple[np.ndarray, ...]") -> Fingerprint:
     )
 
 
+# ---------------------------------------------------------------- records
+
+#: Per-word-index odd multipliers for the record binding word — each is
+#: a bijection on uint32 (odd ⇒ invertible mod 2^32), distinct per word
+#: position so transposing two words of one record moves the mix.
+def _mix_mult(i: int) -> np.uint32:
+    return np.uint32((0x9E3779B1 * (2 * i + 3)) & _U32 | 1)
+
+
+def record_mix(words: "tuple[np.ndarray, ...]") -> np.ndarray:
+    """Per-record binding word over a record's key+payload words: the
+    XOR of each word scaled by a position-distinct odd constant.  The
+    per-word XOR/sum folds alone cannot see a *pairing* error — a sort
+    that permutes keys correctly but gathers the wrong payload lanes
+    preserves both multisets individually — while the mix multiset
+    moves unless every (key, payload) pair survives intact."""
+    mix = np.zeros(words[0].shape, np.uint32)
+    for i, w in enumerate(words):
+        mix ^= np.asarray(w, np.uint32) * _mix_mult(i)
+    return mix
+
+
+def fingerprint_records(key_words: "tuple[np.ndarray, ...]",
+                        payload_words: "tuple[np.ndarray, ...]",
+                        ) -> Fingerprint:
+    """Multiset fingerprint of key+payload records: the ordinary
+    per-word fold over every key AND payload word, plus one extra
+    folded word — :func:`record_mix` — that binds each key to its own
+    payload.  Input side folds at pack/spill time, output side after
+    the permuted gather; equality (with sorted keys) means the output
+    is the key-sorted permutation of exactly the input records."""
+    words = tuple(key_words) + tuple(payload_words)
+    return fingerprint_host(words + (record_mix(words),))
+
+
 # ------------------------------------------------------------------ device
 
 def _xor_reduce_1d(w: "jax.Array") -> "jax.Array":

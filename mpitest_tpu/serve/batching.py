@@ -54,12 +54,25 @@ class ServeRequest:
     algo: str
     batchable: bool
     faults: str | None = None
+    #: per-record payload bytes (ISSUE 15): a ``(n, width)`` uint8
+    #: matrix riding the keys through the record sort.  Payload
+    #: requests dispatch solo (the packed path is keys-only).
+    payload: np.ndarray | None = None
+    payload_width: int = 0
+    #: out-of-core spill-tier request (ISSUE 15): ``arr``/``payload``
+    #: are disk-backed memmaps of the staged input and the dispatch
+    #: runs the external sort; solo by construction.
+    spill: bool = False
     #: wire/client-minted request trace id (ISSUE 10) — stamped on every
     #: span this request touches via ``spans.trace_context``.
     trace_id: str = ""
     t_enq: float = field(default_factory=time.perf_counter)
     done: threading.Event = field(default_factory=threading.Event)
     result: np.ndarray | None = None
+    #: record requests: the permuted payload, (n, width) uint8.
+    result_payload: np.ndarray | None = None
+    #: spill requests: the merged output run the reply streams from.
+    result_run: object | None = None
     error: tuple[str, str] | None = None    # (code, detail)
     batched: bool = False
     bucket: int | None = None
@@ -109,8 +122,12 @@ class ServeRequest:
 
     def complete(self, out: np.ndarray, batched: bool,
                  bucket: int | None, batch_id: str | None = None,
-                 plan: dict | None = None) -> None:
+                 plan: dict | None = None,
+                 payload: np.ndarray | None = None,
+                 run: object | None = None) -> None:
         self.result = out
+        self.result_payload = payload
+        self.result_run = run
         self.batched = batched
         self.bucket = bucket
         self.batch_id = batch_id
@@ -289,8 +306,11 @@ class Batcher:
                 continue
             if not req.batchable or req.faults is not None:
                 self.solo_requests += 1
+                # kind "spill" lets the watchdog age the (legitimately
+                # long) out-of-core dispatch against the completion
+                # bound instead of the per-dispatch one
                 self._guarded(lambda r=req: self.run_solo(r), [req],
-                              "solo")
+                              "spill" if req.spill else "solo")
                 continue
             batch = [req]
             total = req.n

@@ -56,10 +56,19 @@ class ServeReply:
     ok: bool
     header: dict
     arr: np.ndarray | None = None
+    #: record requests (ISSUE 15): the payload permuted into key order,
+    #: an ``(n, payload_bytes)`` uint8 matrix.
+    payload: np.ndarray | None = None
 
     @property
     def error(self) -> str | None:
         return None if self.ok else str(self.header.get("error"))
+
+    @property
+    def spilled(self) -> bool:
+        """True when the server served this request from the
+        out-of-core spill tier (ISSUE 15)."""
+        return bool(self.header.get("spilled"))
 
     @property
     def detail(self) -> str:
@@ -110,18 +119,40 @@ class ServeClient:
     def sort(self, arr: np.ndarray, algo: str | None = None,
              faults: str | None = None,
              trace_id: str | None = None,
-             deadline_ms: float | None = None) -> ServeReply:
+             deadline_ms: float | None = None,
+             payload: np.ndarray | bytes | None = None) -> ServeReply:
         """Send one sort request; block for the reply.  A ``trace_id``
         is minted here when the caller supplies none — the client IS
         the wire layer, so every request carries one end to end (the
         server echoes it in the response header).  ``deadline_ms``
         rides the header (ISSUE 11): the server cancels the request
         typed ``deadline_exceeded`` if the budget expires before
-        dispatch."""
+        dispatch.  ``payload`` (ISSUE 15) turns the request into a
+        record sort: bytes (``n * width``) or an ``(n, width)`` uint8
+        matrix of per-record payloads, returned permuted into key
+        order on ``reply.payload``."""
         arr = np.ascontiguousarray(arr).reshape(-1)
+        n = int(arr.size)
         hdr: dict = {"v": WIRE_SCHEMA, "dtype": arr.dtype.name,
-                     "n": int(arr.size),
+                     "n": n,
                      "trace_id": trace_id or os.urandom(8).hex()}
+        pay_bytes = b""
+        if payload is not None:
+            if isinstance(payload, (bytes, bytearray, memoryview)):
+                pay_bytes = bytes(payload)
+            else:
+                # raw little-endian BYTES of the array — the same
+                # canonical form as the library's as_payload_matrix (a
+                # uint64 row-id array is a valid 8-byte payload as-is).
+                # A value-cast to uint8 here would silently truncate
+                # every payload element above 255.
+                pay_bytes = np.ascontiguousarray(
+                    np.asarray(payload)).tobytes()
+            if n == 0 or len(pay_bytes) % n:
+                raise ValueError(
+                    f"payload of {len(pay_bytes)} bytes is not a "
+                    f"multiple of the key count {n}")
+            hdr["payload_bytes"] = len(pay_bytes) // n
         if algo is not None:
             hdr["algo"] = algo
         if faults is not None:
@@ -129,7 +160,7 @@ class ServeClient:
         if deadline_ms is not None:
             hdr["deadline_ms"] = float(deadline_ms)
         self.sock.sendall(json.dumps(hdr).encode("utf-8") + b"\n"
-                          + arr.tobytes())
+                          + arr.tobytes() + pay_bytes)
         line = self._rfile.readline()
         if not line:
             raise ConnectionError("server closed the connection "
@@ -137,14 +168,21 @@ class ServeClient:
         resp = json.loads(line.decode("utf-8"))
         if not resp.get("ok"):
             return ServeReply(False, resp)
-        nbytes = int(resp["n"]) * np.dtype(str(resp["dtype"])).itemsize
-        payload = self._rfile.read(nbytes)
-        if len(payload) != nbytes:
+        rn = int(resp["n"])
+        dt = np.dtype(str(resp["dtype"]))
+        width = int(resp.get("payload_bytes", 0) or 0)
+        nbytes = rn * (dt.itemsize + width)
+        blob = self._rfile.read(nbytes)
+        if len(blob) != nbytes:
             raise ConnectionError(
-                f"short response payload ({len(payload)}/{nbytes})")
-        out = np.frombuffer(payload,
-                            dtype=np.dtype(str(resp["dtype"]))).copy()
-        return ServeReply(True, resp, out)
+                f"short response payload ({len(blob)}/{nbytes})")
+        out = np.frombuffer(blob[:rn * dt.itemsize], dtype=dt).copy()
+        out_pay = None
+        if width:
+            out_pay = np.frombuffer(
+                blob[rn * dt.itemsize:], np.uint8).reshape(rn,
+                                                           width).copy()
+        return ServeReply(True, resp, out, out_pay)
 
 
 def reply_fingerprint_ok(request: np.ndarray,

@@ -7,10 +7,19 @@ requests back to back):
   ``{"v": "sortserve.v1", "dtype": "int32", "n": 4096}`` with optional
   ``"algo"`` (radix | sample; solo dispatches only), ``"trace_id"``
   (1-64 chars of ``[A-Za-z0-9_-]``; minted server-side when absent and
-  echoed in the response — the end-to-end request-trace key, ISSUE 10)
+  echoed in the response — the end-to-end request-trace key, ISSUE 10),
+  ``"payload_bytes"`` (ISSUE 15: per-record payload width — the keys
+  become records; ``n * payload_bytes`` raw payload bytes follow the
+  key bytes and come back permuted into key order)
   and ``"faults"`` (a ``SORT_FAULTS`` spec, honored only when the
   server runs with ``SORT_SERVE_ALLOW_FAULTS=1``) — followed by exactly
-  ``n * itemsize`` raw little-endian key bytes.
+  ``n * itemsize`` raw little-endian key bytes (then the payload
+  section, when declared).  A request whose total bytes exceed
+  ``SORT_SERVE_MAX_BYTES`` routes to the out-of-core **spill tier**
+  (``SORT_SERVE_SPILL``, ISSUE 15): the bytes stream straight to disk,
+  the external sort serves them under ``SORT_MEM_BUDGET``, and the ok
+  response carries ``"spilled": true`` (+ the plan digest's
+  ``spilled``) instead of the old typed ``bytes`` rejection.
 * response: one JSON header line — ``{"ok": true, "n": ..., "batched":
   ..., "bucket": ..., "trace_id": ..., "batch_id": ..., "plan": ...}``
   (``plan`` is the compact decision digest of ISSUE 12 — algo,
@@ -105,6 +114,11 @@ ERR_DEADLINE_EXCEEDED = ERR_DEADLINE
 #: server to read exabytes to keep framing).
 MAX_REQUEST_KEYS = 1 << 31
 
+#: Sanity cap on the per-record payload width (ISSUE 15): 64 KiB per
+#: record is far past any key-attached handle; bigger payloads belong
+#: in an object store keyed by a payload-resident id.
+MAX_PAYLOAD_WIDTH = 1 << 16
+
 #: Wire-supplied trace ids: short, log/filename-safe tokens.  Anything
 #: else is a typed bad_request — trace ids land in span attrs, file
 #: names and report output, so the grammar is closed.
@@ -169,6 +183,15 @@ class ServerCore:
         self.read_timeout_s = knobs.get("SORT_SERVE_READ_TIMEOUT_S")
         self.completion_timeout_s = knobs.get(
             "SORT_SERVE_COMPLETION_TIMEOUT_S")
+        #: out-of-core spill tier (ISSUE 15): requests larger than the
+        #: admission byte bound spill to disk and ride the external
+        #: sort instead of a typed 'bytes' rejection.  The tier's
+        #: memory budget is SORT_MEM_BUDGET when set, else the
+        #: admission bound itself (the byte bound IS the host-memory
+        #: statement the operator already made).
+        self.spill_enabled = knobs.get("SORT_SERVE_SPILL") != "off"
+        self.spill_budget = (knobs.get("SORT_MEM_BUDGET")
+                             or knobs.get("SORT_SERVE_MAX_BYTES"))
         self.cache = ExecutorCache(self.tracer.spans)
         self.admission = AdmissionControl(
             knobs.get("SORT_SERVE_MAX_INFLIGHT"),
@@ -227,6 +250,28 @@ class ServerCore:
         self._inflight_reqs: dict[str, ServeRequest] = {}
         self._inflight_lock = threading.Lock()
 
+    #: Disk headroom a spill request must fit under: staged input +
+    #: merged output + merge intermediates.  Without this check the
+    #: spill tier would convert the old memory-protection rejection
+    #: into a disk-exhaustion vector (huge declared n, or a full
+    #: volume surfacing as an untyped OSError mid-stage).
+    SPILL_DISK_FACTOR = 3
+
+    def spill_disk_ok(self, nbytes: int) -> bool:
+        """True when the spill volume has room for a request of
+        ``nbytes`` (input + output + intermediates); False degrades to
+        the ordinary typed ``bytes`` rejection."""
+        import shutil
+
+        from mpitest_tpu.store import external
+
+        try:
+            free = shutil.disk_usage(
+                external.resolve_spill_dir(None)).free
+        except OSError:
+            return False
+        return free >= self.SPILL_DISK_FACTOR * nbytes
+
     def start_watchdog(self) -> None:
         """Start the dispatch-watchdog thread (no-op when
         ``SORT_SERVE_DISPATCH_TIMEOUT_S=0``)."""
@@ -272,8 +317,36 @@ class ServerCore:
                 if reg is not None:
                     faults.install(reg)
                 try:
-                    out = api.sort(req.arr, algorithm=req.algo,
-                                   mesh=self.mesh, tracer=self.tracer)
+                    if req.spill:
+                        # out-of-core tier (ISSUE 15): external sort
+                        # over the disk-staged input, merged output
+                        # streamed into one run the reply reads from
+                        from mpitest_tpu.store import external
+
+                        # out_name: server-minted nonce, NOT the
+                        # client trace_id (see _spill_wire — a reused
+                        # id must never collide one request's reply
+                        # stream with another's dispatch)
+                        res = external.external_sort(
+                            req.arr, req.payload
+                            if req.payload_width else None,
+                            algorithm=req.algo, mesh=self.mesh,
+                            tracer=self.tracer,
+                            budget=self.spill_budget,
+                            sink="file",
+                            out_name=f"out_{mint_trace_id()}")
+                        out, out_pay, out_run = None, None, res.out_run
+                    elif req.payload_width:
+                        # record sort (ISSUE 15): key+payload through
+                        # the fused argsort-gather
+                        out, out_pay = api.sort(
+                            req.arr, algorithm=req.algo, mesh=self.mesh,
+                            tracer=self.tracer, payload=req.payload)
+                        out_run = None
+                    else:
+                        out = api.sort(req.arr, algorithm=req.algo,
+                                       mesh=self.mesh, tracer=self.tracer)
+                        out_pay = out_run = None
                 finally:
                     if reg is not None:
                         faults.install(None)
@@ -284,13 +357,19 @@ class ServerCore:
             p = self.tracer.plan
             req.complete(out, batched=False, bucket=None,
                          plan=p.digest() if isinstance(
-                             p, plan_mod.SortPlan) else None)
+                             p, plan_mod.SortPlan) else None,
+                         payload=out_pay, run=out_run)
         except supervision.SortIntegrityError as e:
             req.fail(ERR_INTEGRITY, str(e))
         except supervision.SortRetryExhausted as e:
             req.fail(ERR_RETRIES, str(e))
         except (ValueError, TypeError, OverflowError) as e:
-            req.fail(ERR_BAD_REQUEST, str(e))
+            from mpitest_tpu.store.runs import RunFormatError
+
+            # a structurally-bad SPILL artifact is the server's disk
+            # problem, never the client's request
+            req.fail(ERR_INTERNAL if isinstance(e, RunFormatError)
+                     else ERR_BAD_REQUEST, str(e))
         except Exception as e:  # noqa: BLE001 — one request's problem,
             # never the server's; an UNtyped failure is an incident the
             # flight recorder must document (api.sort dumps the typed
@@ -422,15 +501,22 @@ class ServerCore:
     def _dispatch_admitted(self, t0: float, attrs: dict, arr: np.ndarray,
                            algo: str | None, faults_spec: str | None,
                            trace_id: str, deadline: float | None = None,
+                           payload: np.ndarray | None = None,
+                           spill: bool = False,
                            ) -> tuple[str, Any, dict]:
         """Dispatch an ALREADY-ADMITTED request and wait for completion.
-        The caller owns the admission release."""
+        The caller owns the admission release.  ``payload`` (ISSUE 15)
+        routes through the record sort; ``spill`` through the
+        out-of-core tier — both solo by construction (the packed path
+        is keys-only and in-memory)."""
+        width = int(payload.shape[1]) if payload is not None else 0
         req = ServeRequest(
             arr=arr, dtype=np.dtype(arr.dtype),
             algo=algo or self.default_algo,
-            batchable=(faults_spec is None
+            batchable=(faults_spec is None and not spill and width == 0
                        and int(arr.size) <= self.batch_keys),
-            faults=faults_spec, trace_id=trace_id, deadline=deadline)
+            faults=faults_spec, trace_id=trace_id, deadline=deadline,
+            payload=payload, payload_width=width, spill=spill)
         # serve auto-tuning (ISSUE 14): every admitted request feeds
         # the rolling mix the window/bucket policies learn from
         self._tuner_observe(int(arr.size), req.dtype.name)
@@ -466,6 +552,12 @@ class ServerCore:
                 self._deadline_event(req.deadline_stage or "queue",
                                      trace_id)
             return self._finish(t0, attrs, req.error[0], req.error[1])
+        if req.result_run is not None:            # spill tier (ISSUE 15)
+            attrs["spilled"] = True
+            return self._finish(t0, attrs, "ok", req.result_run)
+        if req.payload_width:                     # record sort
+            return self._finish(t0, attrs, "ok",
+                                (req.result, req.result_payload))
         return self._finish(t0, attrs, "ok", req.result)
 
     def _tuner_observe(self, n: int, dtype_name: str = "int32") -> None:
@@ -555,34 +647,72 @@ class ServerCore:
                 faults_spec: str | None = None,
                 trace_id: str | None = None,
                 deadline_ms: float | None = None,
+                payload: np.ndarray | None = None,
                 ) -> tuple[str, Any, dict]:
         """Admit, dispatch and complete one request (the in-process
         entry; the wire path admits BEFORE materializing the payload —
         see :meth:`handle_wire`).  Returns ``(status, payload, attrs)``
-        where status ``"ok"`` carries the sorted array and any error
+        where status ``"ok"`` carries the sorted array (a
+        ``(keys, payload)`` pair for record requests) and any error
         status carries the detail string.  ``trace_id`` is minted when
         the caller supplies none; it lands in ``attrs`` and on every
         span the request touches.  ``deadline_ms`` (optional) is the
         caller's remaining latency budget: once it expires the request
         is cancelled typed ``deadline_exceeded`` at whatever lifecycle
-        stage it had reached — never dispatched late."""
+        stage it had reached — never dispatched late.
+
+        A request whose bytes exceed ``SORT_SERVE_MAX_BYTES`` outright
+        routes to the spill tier (ISSUE 15) instead of the typed
+        ``bytes`` rejection — unless ``SORT_SERVE_SPILL=off``."""
         t0 = time.perf_counter()
         tid = trace_id or mint_trace_id()
         deadline = (time.monotonic() + deadline_ms / 1e3
                     if deadline_ms is not None else None)
-        nbytes = int(arr.nbytes)
+        pay = None
+        if payload is not None:
+            from mpitest_tpu.models.records import as_payload_matrix
+
+            pay = as_payload_matrix(payload, int(arr.size))
+        nbytes = int(arr.nbytes) + (int(pay.nbytes) if pay is not None
+                                    else 0)
         attrs: dict = {"n": int(arr.size), "dtype": str(arr.dtype),
                        "trace_id": tid}
+        spill = False
+        if (nbytes > self.admission.max_bytes and self.spill_enabled
+                and faults_spec is None and self.spill_disk_ok(nbytes)):
+            # the request can NEVER fit the byte bound — the spill
+            # tier serves it from disk under count-only admission
+            # (no disk headroom ⇒ fall through to the byte rejection)
+            spill = True
+            attrs["spilled"] = True
+            nbytes = 0
         try:
             self._admit(nbytes)
         except AdmissionReject as e:
             attrs["reject"] = e.reason
             return self._finish(t0, attrs, self.reject_code(e), str(e))
+        if spill:
+            self.metrics.counter(
+                "sort_external_spilled_requests_total").inc(1)
         try:
-            return self._dispatch_admitted(t0, attrs, arr, algo,
-                                           faults_spec, tid, deadline)
+            status, result, attrs = self._dispatch_admitted(
+                t0, attrs, arr, algo, faults_spec, tid, deadline,
+                payload=pay, spill=spill)
         finally:
             self.admission.release(nbytes)
+        if status == "ok" and spill:
+            # in-process callers want arrays, not the output run: read
+            # it back (the run files are unlinked once viewed)
+            from mpitest_tpu.store import runs as runlib
+
+            views = runlib.run_body_views(result, unlink=True)
+            keys = np.frombuffer(views[0], dtype=arr.dtype).copy()
+            if pay is not None:
+                out_pay = np.frombuffer(
+                    views[1], np.uint8).reshape(pay.shape).copy()
+                return status, (keys, out_pay), attrs
+            return status, keys, attrs
+        return status, result, attrs
 
     # -- wire handling ------------------------------------------------
     def wire_timeout(self, kind: str) -> None:
@@ -593,7 +723,8 @@ class ServerCore:
 
     def _read_wire(self, rfile: BinaryIO, nbytes: int,
                    conn: "socket.socket | None",
-                   keep: bool = True) -> tuple[bytes, str]:
+                   keep: bool = True,
+                   sink: Any = None) -> tuple[bytes, str]:
         """Read exactly ``nbytes`` under ONE total wall budget
         (``SORT_SERVE_READ_TIMEOUT_S``).  On a socket the loop uses
         ``read1`` — AT MOST ONE underlying ``recv`` per call — with
@@ -604,8 +735,10 @@ class ServerCore:
         budget (ISSUE 11).  Returns ``(data, outcome)`` with outcome
         ``"ok"``, ``"short"`` (EOF / reset) or ``"timeout"``;
         ``keep=False`` drops the bytes (the discard path) instead of
-        accumulating them.  ``conn`` None (in-process callers reading
-        from a BytesIO) reads unbounded — there is no socket to
+        accumulating them, and ``sink`` (a callable taking one bytes
+        chunk — the spill tier's disk stage) consumes them without
+        accumulation either way.  ``conn`` None (in-process callers
+        reading from a BytesIO) reads unbounded — there is no socket to
         stall."""
         chunks: list[bytes] = []
         got = 0
@@ -635,7 +768,9 @@ class ServerCore:
                 return b"".join(chunks), "short"
             if not chunk:
                 return b"".join(chunks), "short"
-            if keep:
+            if sink is not None:
+                sink(chunk)
+            elif keep:
                 chunks.append(chunk)
             got += len(chunk)
         return b"".join(chunks), "ok"
@@ -680,28 +815,33 @@ class ServerCore:
                 return bytes(line), "ok"
         return bytes(line), "closed"
 
-    def write_wire(self, conn: "socket.socket", blob: bytes) -> str:
+    def write_wire(self, conn: "socket.socket",
+                   blob: "bytes | list") -> str:
         """Send a response under ONE total wall budget (the read
         timeout): per-``send`` socket timeouts reset on any progress,
         so a client reading one byte per interval could otherwise pin
-        the handler thread for hours on a large payload.  Returns
+        the handler thread for hours on a large payload.  ``blob`` may
+        be a list of byte-like segments (the spill tier's zero-copy
+        run views) — all segments share the one budget.  Returns
         ``"ok"``, ``"timeout"`` or ``"closed"``."""
-        view = memoryview(blob)
-        off = 0
+        segments = blob if isinstance(blob, list) else [blob]
         deadline = time.monotonic() + self.read_timeout_s
-        while off < len(view):
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
-                self.wire_timeout("write")
-                return "timeout"
-            try:
-                conn.settimeout(remaining)
-                off += conn.send(view[off:off + (1 << 20)])
-            except TimeoutError:
-                self.wire_timeout("write")
-                return "timeout"
-            except OSError:
-                return "closed"
+        for seg in segments:
+            view = memoryview(seg)
+            off = 0
+            while off < len(view):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    self.wire_timeout("write")
+                    return "timeout"
+                try:
+                    conn.settimeout(remaining)
+                    off += conn.send(view[off:off + (1 << 20)])
+                except TimeoutError:
+                    self.wire_timeout("write")
+                    return "timeout"
+                except OSError:
+                    return "closed"
         return "ok"
 
     def _discard(self, rfile: BinaryIO, nbytes: int,
@@ -773,7 +913,17 @@ class ServerCore:
             return err(ERR_BAD_REQUEST,
                        f"bad n={n!r} (integer in [1, {MAX_REQUEST_KEYS}])",
                        keep=False)
-        nbytes = n * dtype.itemsize
+        # payload_bytes (ISSUE 15): per-record payload width.  The
+        # payload section (n * payload_bytes raw bytes) follows the key
+        # bytes; the reply mirrors the framing with the payload
+        # permuted into key order.
+        width = hdr.get("payload_bytes", 0)
+        if not isinstance(width, int) or isinstance(width, bool) or \
+                not 0 <= width <= MAX_PAYLOAD_WIDTH:
+            return err(ERR_BAD_REQUEST,
+                       f"bad payload_bytes={width!r} (integer in "
+                       f"[0, {MAX_PAYLOAD_WIDTH}])", keep=False)
+        nbytes = n * (dtype.itemsize + width)
         # deadline_ms (ISSUE 11): the client's remaining latency budget
         # becomes an ABSOLUTE monotonic deadline right here, carried
         # through admission -> queue -> dispatch; expired work is
@@ -816,6 +966,17 @@ class ServerCore:
         # bound really bounds host memory, not just dispatch.
         t0 = time.perf_counter()
         attrs: dict = {"n": n, "dtype": dtype.name, "trace_id": tid}
+        if width:
+            attrs["payload_bytes"] = width
+        if (nbytes > self.admission.max_bytes and self.spill_enabled
+                and faults_spec is None and self.spill_disk_ok(nbytes)):
+            # spill tier (ISSUE 15): the request can NEVER fit the
+            # byte bound — stream it to disk and serve it out-of-core
+            # instead of the old typed 'bytes' rejection.  No disk
+            # headroom (3x the request) ⇒ the ordinary typed rejection
+            # below, never an untyped OSError mid-stage.
+            return self._spill_wire(t0, attrs, rfile, conn, n, dtype,
+                                    width, algo, tid, deadline, err)
         try:
             self._admit(nbytes)
         except AdmissionReject as e:
@@ -841,21 +1002,40 @@ class ServerCore:
                 # in the serve.request span stream / error tally too
                 self._finish(t0, attrs, ERR_BAD_REQUEST, detail)
                 return err(ERR_BAD_REQUEST, detail, keep=False)
-            arr = np.frombuffer(payload, dtype=dtype).copy()
+            key_bytes = n * dtype.itemsize
+            arr = np.frombuffer(payload[:key_bytes], dtype=dtype).copy()
+            pay = None
+            if width:
+                pay = np.frombuffer(
+                    payload[key_bytes:], np.uint8).reshape(n,
+                                                           width).copy()
             del payload
             status, result, attrs = self._dispatch_admitted(
                 t0, attrs, arr, algo,
                 str(faults_spec) if faults_spec is not None else None,
-                tid, deadline)
+                tid, deadline, payload=pay)
         finally:
             self.admission.release(nbytes)
         if status != "ok":
             return err(status, str(result))
+        return self._ok_response(n, dtype, width, attrs, tid, result)
+
+    def _ok_response(self, n: int, dtype: np.dtype, width: int,
+                     attrs: dict, tid: str, result: Any,
+                     ) -> tuple[dict, Any, bool]:
+        """Build the ok wire response.  ``result`` is the sorted array,
+        a ``(keys, payload)`` pair (records) or a
+        :class:`~mpitest_tpu.store.runs.RunInfo` (spill tier — the
+        reply streams zero-copy memoryviews of the output run)."""
         resp = {"v": WIRE_SCHEMA, "ok": True, "n": n,
                 "dtype": dtype.name,
                 "batched": bool(attrs.get("batched")),
                 "bucket": attrs.get("bucket"),
                 "trace_id": tid}
+        if width:
+            resp["payload_bytes"] = width
+        if attrs.get("spilled"):
+            resp["spilled"] = True
         if attrs.get("batch_id") is not None:
             resp["batch_id"] = attrs["batch_id"]
         if attrs.get("plan") is not None:
@@ -863,7 +1043,80 @@ class ServerCore:
             # restage verdict, regret — decision drift is observable
             # from the client side without the span stream
             resp["plan"] = attrs["plan"]
+        if attrs.get("spilled"):
+            from mpitest_tpu.store import runs as runlib
+
+            return resp, runlib.run_body_views(result, unlink=True), True
+        if width:
+            keys, pay = result
+            return resp, (np.ascontiguousarray(keys).tobytes()
+                          + np.ascontiguousarray(pay).tobytes()), True
         return resp, np.ascontiguousarray(result).tobytes(), True
+
+    def _spill_wire(self, t0: float, attrs: dict, rfile: BinaryIO,
+                    conn: "socket.socket | None", n: int,
+                    dtype: np.dtype, width: int, algo: str | None,
+                    tid: str, deadline: float | None,
+                    err: Any) -> tuple[dict, Any, bool]:
+        """The wire spill tier: stream the over-budget request's bytes
+        straight from the socket into spill-dir staging files (host
+        memory never holds them), dispatch the external sort over the
+        staged memmaps, and reply from the merged output run.  Admitted
+        under the COUNT bound only (bytes live on disk); the staged
+        and output files are unlinked as soon as they are mapped, so no
+        exit path can leak them."""
+        from mpitest_tpu.store import external
+        from mpitest_tpu.store import runs as runlib
+
+        attrs["spilled"] = True
+        try:
+            self._admit(0)
+        except AdmissionReject as e:
+            attrs["reject"] = e.reason
+            code, detail, _ = self._finish(t0, attrs,
+                                           self.reject_code(e), str(e))
+            keep = self._discard(rfile, n * (dtype.itemsize + width),
+                                 conn)
+            return err(code, str(detail), keep=keep)
+        self.metrics.counter(
+            "sort_external_spilled_requests_total").inc(1)
+        # staging/output names carry a SERVER-minted nonce, never the
+        # client-supplied trace_id: two concurrent requests reusing one
+        # trace_id (only grammar-checked) must not share disk paths —
+        # interleaved staged bytes would be folded as-is and VERIFY
+        # cleanly while carrying the other client's data.
+        nonce = mint_trace_id()
+        try:
+            stage = runlib.InputStage(
+                external.resolve_spill_dir(None), f"in_{nonce}", dtype,
+                n, width)
+            try:
+                _, outcome = self._read_wire(rfile, n * dtype.itemsize,
+                                             conn, sink=stage.key_sink)
+                if outcome == "ok" and width:
+                    _, outcome = self._read_wire(rfile, n * width, conn,
+                                                 sink=stage.pay_sink)
+                if outcome != "ok":
+                    if outcome == "timeout":
+                        self.wire_timeout("read")
+                    stage.abort()
+                    detail = (f"payload read "
+                              f"{'timed out' if outcome == 'timeout' else 'short'}"
+                              " (spill tier)")
+                    self._finish(t0, attrs, ERR_BAD_REQUEST, detail)
+                    return err(ERR_BAD_REQUEST, detail, keep=False)
+                arr, pay = stage.finish()
+            except runlib.RunFormatError as e:
+                self._finish(t0, attrs, ERR_INTERNAL, str(e))
+                return err(ERR_INTERNAL, str(e), keep=False)
+            status, result, attrs = self._dispatch_admitted(
+                t0, attrs, arr, algo, None, tid, deadline, payload=pay,
+                spill=True)
+        finally:
+            self.admission.release(0)
+        if status != "ok":
+            return err(status, str(result))
+        return self._ok_response(n, dtype, width, attrs, tid, result)
 
     # -- lifecycle ----------------------------------------------------
     def start_drain(self) -> None:
@@ -900,8 +1153,11 @@ class _Handler(socketserver.StreamRequestHandler):
                                                    self.connection)
             # response writes share the wire budget: a client that
             # stops (or trickles) reading cannot pin this thread on a
-            # full send buffer
-            blob = json.dumps(resp).encode("utf-8") + b"\n" + payload
+            # full send buffer.  A list payload (the spill tier's
+            # zero-copy run views) streams segment by segment.
+            header = json.dumps(resp).encode("utf-8") + b"\n"
+            blob = ([header] + payload if isinstance(payload, list)
+                    else header + payload)
             if core.write_wire(self.connection, blob) != "ok":
                 return
             if not keep:

@@ -176,7 +176,15 @@ class DispatchWatchdog:
         if hb is not None:
             age_s, kind, trace_ids = hb
             started_key = time.monotonic() - age_s
-            if age_s >= self.timeout_s and (
+            # a spill (out-of-core) dispatch is legitimately long — it
+            # streams many small device sorts + a disk merge — so it
+            # ages against the COMPLETION bound, not the per-dispatch
+            # wedge bound (a wedged device inside it still types out
+            # through the per-chunk supervisor)
+            bound = (max(self.timeout_s,
+                         float(self.core.completion_timeout_s))
+                     if kind == "spill" else self.timeout_s)
+            if age_s >= bound and (
                     self._tripped_for is None
                     or abs(started_key - self._tripped_for) > 0.5):
                 self._tripped_for = started_key

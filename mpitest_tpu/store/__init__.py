@@ -1,0 +1,35 @@
+"""Out-of-core sorted-run store (ISSUE 15): spill runs, k-way merge,
+and the external-sort driver that turns dataset size from an HBM limit
+into a disk limit.
+
+Exports are PEP 562 lazy (like ``serve/``): importing the package costs
+nothing until a symbol is touched, so the client-side and lint surfaces
+never pull jax.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+_EXPORTS = {
+    "RunFormatError": "mpitest_tpu.store.runs",
+    "RunInfo": "mpitest_tpu.store.runs",
+    "open_run": "mpitest_tpu.store.runs",
+    "read_run_chunks": "mpitest_tpu.store.runs",
+    "verify_run": "mpitest_tpu.store.runs",
+    "write_run": "mpitest_tpu.store.runs",
+    "merge_runs": "mpitest_tpu.store.merge",
+    "external_sort": "mpitest_tpu.store.external",
+    "external_sort_file": "mpitest_tpu.store.external",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str) -> Any:
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(mod), name)

@@ -1,0 +1,598 @@
+"""Out-of-core external sort: partition → device-sort → spill → k-way merge.
+
+The in-memory path is bounded by device/host memory; this driver is
+bounded by disk.  The input partitions into ``SORT_MEM_BUDGET``-sized
+chunks; each chunk rides the ordinary **verified** device sort
+(``models/api.sort`` for keys, the record argsort-gather for
+key+payload) and spills to a sorted run (``store/runs.py``: SORTBIN1
+framing + fingerprint sidecar); the runs then stream through the
+bounded k-way merge (``store/merge.py``), at most ``SORT_MERGE_FANIN``
+at a time (more runs merge in passes through intermediate runs, each
+written through the streaming run writer — no pass materializes its
+output).
+
+The budget is deliberately forceable far below real memory, so the
+whole spill/merge machinery is exercised on a laptop-sized dataset in
+CI (``make external-selftest``); on real hardware the same knob makes
+dataset size a disk limit.
+
+Integrity ladder (the external twin of the supervisor ladder):
+
+1. every chunk sort is already supervised + fingerprint-verified;
+2. every run carries a sidecar folded before its bytes hit disk; the
+   merge re-folds each run on read-back and raises a typed
+   :class:`~mpitest_tpu.store.merge.RunIntegrityError` naming a bad
+   run (the ``spill_corrupt`` shape);
+3. the merged output is folded chunk-by-chunk and compared against the
+   COMBINED run sidecars (count + per-word XOR/sum + record mix) with
+   a boundary-inclusive sortedness sweep — silent merge truncation
+   (the ``merge_drop`` shape) trips here;
+4. a tripped check re-spills exactly the blamed slices from the source
+   and re-merges (one recovery round, ``external.recover`` event +
+   ``sort_external_recoveries_total``); a second failure raises the
+   typed ``SortIntegrityError`` — never silent wrong bytes.
+
+Telemetry: registered ``external.run`` / ``external.merge`` spans (+
+the ``external.recover`` event) ride the ordinary span stream and feed
+the ``sort_external_*`` live metrics through the span bridge; the plan
+record (ISSUE 12) grows an ``external`` decision so ``--explain`` and
+the serve plan digest (``spilled: true``) name the tier that ran.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import time
+from dataclasses import dataclass
+from itertools import islice
+from typing import Any, Callable, Iterator
+
+import numpy as np
+
+from mpitest_tpu.models import plan as plan_mod
+from mpitest_tpu.models.supervisor import SortIntegrityError
+from mpitest_tpu.ops.keys import codec_for
+from mpitest_tpu.store import merge as mergelib
+from mpitest_tpu.store import runs as runlib
+from mpitest_tpu.utils import knobs
+
+#: Host-memory multiplier per record during partition/sort: the raw
+#: chunk + its encoded words + the device copy + sort working set.
+#: chunk_elems = budget // (SPILL_FACTOR * record_bytes).
+SPILL_FACTOR = 4
+
+#: Floor on chunk/buffer sizes — below this the per-chunk overheads
+#: (dispatch, syscalls) dominate and the budget arithmetic is noise.
+MIN_CHUNK_ELEMS = 1 << 10
+
+#: Recovery budget: full merge attempts before the typed error.
+MERGE_ATTEMPTS = 2
+
+
+@dataclass
+class ExternalResult:
+    """Outcome of one external sort."""
+
+    n: int
+    dtype: np.dtype
+    payload_width: int
+    runs: int                 # spill runs written by the partition pass
+    disk_bytes: int           # bytes spilled (initial runs)
+    merge_passes: int         # k-way passes (1 = single final pass)
+    recoveries: int           # integrity recoveries taken
+    keys: np.ndarray | None = None        # sink="array"
+    payload: np.ndarray | None = None     # sink="array", records only
+    out_run: "runlib.RunInfo | None" = None   # sink="file"
+
+
+def _budget() -> int:
+    return int(knobs.get("SORT_MEM_BUDGET"))
+
+
+def _fanin() -> int:
+    return int(knobs.get("SORT_MERGE_FANIN"))
+
+
+def resolve_spill_dir(spill_dir: str | None = None) -> str:
+    """The spill staging directory: the explicit argument, else
+    ``SORT_SPILL_DIR``, else a fresh per-process temp dir."""
+    d = spill_dir or knobs.get("SORT_SPILL_DIR")
+    if not d:
+        d = os.path.join(tempfile.gettempdir(),
+                         f"mpitest_spill_{os.getpid()}")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def spill_chunk_elems(budget: int, dtype: np.dtype,
+                      payload_width: int = 0) -> int:
+    """Records per partition chunk under ``budget`` bytes."""
+    rec = int(np.dtype(dtype).itemsize) + int(payload_width)
+    return max(MIN_CHUNK_ELEMS, budget // max(1, SPILL_FACTOR * rec))
+
+
+def merge_chunk_elems(budget: int, dtype: np.dtype, payload_width: int,
+                      n_runs: int) -> int:
+    """Records per per-run read-ahead buffer during a merge of
+    ``n_runs`` runs: the buffers plus one output round must fit the
+    budget."""
+    rec = int(np.dtype(dtype).itemsize) + int(payload_width)
+    per_run = budget // max(1, SPILL_FACTOR * rec * (n_runs + 2))
+    return max(MIN_CHUNK_ELEMS, per_run)
+
+
+def _sort_chunk(keys: np.ndarray, pay: np.ndarray | None,
+                algorithm: str, mesh: Any, tracer: Any,
+                ) -> tuple[np.ndarray, np.ndarray | None]:
+    """One supervised, verified device sort of a partition chunk."""
+    from mpitest_tpu.models import api
+
+    if pay is not None:
+        out_k, out_p = api.sort(keys, algorithm=algorithm, mesh=mesh,
+                                tracer=tracer, payload=pay)
+        return out_k, out_p
+    return api.sort(np.asarray(keys), algorithm=algorithm, mesh=mesh,
+                    tracer=tracer), None
+
+
+def _spans(tracer: Any):
+    return tracer.spans if tracer is not None else None
+
+
+def _spill_one(idx: int, keys: np.ndarray, pay: np.ndarray | None,
+               spill_dir: str, algorithm: str, mesh: Any, tracer: Any,
+               ) -> "runlib.RunInfo":
+    t0 = time.perf_counter()
+    out_k, out_p = _sort_chunk(keys, pay, algorithm, mesh, tracer)
+    info = runlib.write_run(spill_dir, f"r{os.getpid():x}_{idx:05d}",
+                            out_k, out_p)
+    spans = _spans(tracer)
+    if spans is not None:
+        spans.record("external.run", t0, time.perf_counter() - t0,
+                     run=idx, n=info.n, bytes=info.disk_bytes,
+                     dtype=info.dtype.name,
+                     payload_width=info.payload_width)
+    return info
+
+
+def _merge_level(level: "list[runlib.RunInfo]", spill_dir: str,
+                 budget: int, fanin: int, dtype: np.dtype, width: int,
+                 pass_idx: int, tracer: Any) -> "list[runlib.RunInfo]":
+    """One fan-in-bounded intermediate pass: groups of ``fanin`` runs
+    merge into one run each, streamed through the run writer."""
+    out: list[runlib.RunInfo] = []
+    for gi in range(0, len(level), fanin):
+        group = level[gi:gi + fanin]
+        if len(group) == 1:
+            out.append(group[0])
+            continue
+        t0 = time.perf_counter()
+        ch = merge_chunk_elems(budget, dtype, width, len(group))
+        w = runlib.RunStreamWriter(
+            spill_dir, f"m{os.getpid():x}_{pass_idx}_{gi:05d}",
+            dtype, width)
+        for kws, pws in mergelib.merge_runs(group, ch):
+            w.append_words(kws, pws)
+        info = w.close()
+        spans = _spans(tracer)
+        if spans is not None:
+            spans.record("external.merge", t0,
+                         time.perf_counter() - t0,
+                         runs=len(group), n=info.n,
+                         bytes=info.disk_bytes, final=False,
+                         merge_pass=pass_idx)
+        out.append(info)
+    return out
+
+
+def external_sort(
+    x: Any,
+    payload: Any = None,
+    *,
+    algorithm: str = "radix",
+    mesh: Any = None,
+    tracer: Any = None,
+    budget: int | None = None,
+    spill_dir: str | None = None,
+    fanin: int | None = None,
+    sink: "str | Callable[[np.ndarray, np.ndarray | None], None]" = "array",
+    out_name: str = "merged",
+) -> ExternalResult:
+    """Externally sort host keys ``x`` (optionally with per-record
+    ``payload`` bytes) under a byte ``budget`` (default
+    ``SORT_MEM_BUDGET``; must be > 0 — the external path never engages
+    implicitly).
+
+    ``sink`` selects where the merged output goes: ``"array"``
+    materializes ``result.keys`` (+ ``result.payload``) — bit-identical
+    to the in-memory sort; ``"file"`` streams it into one output run
+    (``result.out_run``) so even the result never lives in host memory
+    (the serve spill tier's reply source); a callable receives each
+    decoded ``(keys_chunk, payload_chunk | None)`` in order (the CLI's
+    streamed median probe)."""
+    from mpitest_tpu.models.records import as_payload_matrix
+
+    keys = np.asarray(x).reshape(-1)
+    dtype = np.dtype(keys.dtype)
+    n = int(keys.size)
+    pay = as_payload_matrix(payload, n) if payload is not None else None
+    width = int(pay.shape[1]) if pay is not None else 0
+
+    def chunks(chunk_elems: int) -> Iterator[
+            tuple[np.ndarray, np.ndarray | None]]:
+        for off in range(0, n, chunk_elems):
+            yield (keys[off:off + chunk_elems],
+                   pay[off:off + chunk_elems] if pay is not None else None)
+
+    return _external_core(chunks, n, dtype, width, algorithm=algorithm,
+                          mesh=mesh, tracer=tracer, budget=budget,
+                          spill_dir=spill_dir, fanin=fanin, sink=sink,
+                          out_name=out_name)
+
+
+def external_sort_file(
+    path: str,
+    dtype: Any = np.int32,
+    *,
+    algorithm: str = "radix",
+    mesh: Any = None,
+    tracer: Any = None,
+    budget: int | None = None,
+    spill_dir: str | None = None,
+    fanin: int | None = None,
+    sink: "str | Callable[[np.ndarray, np.ndarray | None], None]" = "array",
+    out_name: str = "merged",
+    sink_factory: Any = None,
+) -> ExternalResult:
+    """External sort of a key FILE — SORTBIN1 or reference text —
+    without ever materializing it: chunks stream through
+    ``utils/io.iter_key_chunks`` (mmap slices for binary; the threaded
+    token-safe block parser for text) straight into spill runs, so a
+    text input larger than ``SORT_MEM_BUDGET`` peaks at chunk-sized
+    host memory instead of the whole file (the PR 2 documented
+    limitation, closed for the external path)."""
+    from mpitest_tpu.utils import io as kio
+
+    dtype = np.dtype(dtype)
+
+    def chunks(chunk_elems: int) -> Iterator[
+            tuple[np.ndarray, np.ndarray | None]]:
+        for c in kio.iter_key_chunks(path, dtype,
+                                     chunk_elems=chunk_elems):
+            yield c, None
+
+    return _external_core(chunks, None, dtype, 0, algorithm=algorithm,
+                          mesh=mesh, tracer=tracer, budget=budget,
+                          spill_dir=spill_dir, fanin=fanin, sink=sink,
+                          out_name=out_name, sink_factory=sink_factory)
+
+
+def _external_core(
+    chunks_fn: Callable[[int], Iterator[tuple[np.ndarray,
+                                              np.ndarray | None]]],
+    n_hint: int | None,
+    dtype: np.dtype,
+    width: int,
+    *,
+    algorithm: str,
+    mesh: Any,
+    tracer: Any,
+    budget: int | None,
+    spill_dir: str | None,
+    fanin: int | None,
+    sink: "str | Callable[[np.ndarray, np.ndarray | None], None]",
+    out_name: str,
+    sink_factory: "Callable[[int], Callable[[np.ndarray, np.ndarray | None], None]] | None" = None,
+) -> ExternalResult:
+    from mpitest_tpu.utils.trace import Tracer
+
+    tracer = tracer or Tracer()
+    trace_path = knobs.get("SORT_TRACE")
+    if trace_path and tracer.spans.stream_path is None:
+        tracer.spans.stream_path = trace_path
+    budget = _budget() if budget is None else int(budget)
+    if budget <= 0:
+        raise ValueError(
+            "external sort needs a positive byte budget "
+            "(SORT_MEM_BUDGET or the budget= argument)")
+    fanin = _fanin() if fanin is None else int(fanin)
+    if fanin < 2:
+        raise ValueError(f"merge fan-in must be >= 2, got {fanin}")
+    spill_dir = resolve_spill_dir(spill_dir)
+    codec = codec_for(dtype)
+    chunk_elems = spill_chunk_elems(budget, dtype, width)
+
+    from mpitest_tpu import faults as faultlib
+
+    reg = faultlib.for_run()
+    from mpitest_tpu.models import supervisor as supervision
+
+    supervision.wire_registry(reg, tracer)
+    spans = _spans(tracer)
+
+    with faultlib.active(reg):
+        # ---- partition + spill --------------------------------------
+        run_infos: list[runlib.RunInfo] = []
+        #: source chunk index behind each run — the recovery path
+        #: re-slices chunks_fn by THIS index (empty chunks are skipped,
+        #: so run order and chunk order can differ)
+        chunk_of_run: list[int] = []
+        n = 0
+        for idx, (kchunk, pchunk) in enumerate(chunks_fn(chunk_elems)):
+            kchunk = np.asarray(kchunk, dtype).reshape(-1)
+            if kchunk.size == 0:
+                continue
+            run_infos.append(_spill_one(idx, kchunk, pchunk, spill_dir,
+                                        algorithm, mesh, tracer))
+            chunk_of_run.append(idx)
+            n += int(kchunk.size)
+        if n_hint is not None and n != n_hint:
+            raise SortIntegrityError(
+                f"partition saw {n} records, expected {n_hint}")
+
+        if not run_infos:
+            res = ExternalResult(0, dtype, width, 0, 0, 0, 0,
+                                 keys=np.empty(0, dtype),
+                                 payload=(np.zeros((0, width), np.uint8)
+                                          if width else None))
+            _finish_plan(tracer, res, budget, fanin)
+            return res
+
+        disk0 = sum(r.disk_bytes for r in run_infos)
+        expected_fp = run_infos[0].fingerprint
+        for r in run_infos[1:]:
+            expected_fp = expected_fp.combine(r.fingerprint)
+
+        # ---- merge (+ bounded integrity recovery) -------------------
+        # partition runs are dataset-sized: deleted on EVERY exit path
+        # below (the success case and the typed failure alike — the
+        # flight recorder, not the disk, carries the postmortem)
+        try:
+            return _merge_with_recovery(
+                chunks_fn, chunk_elems, run_infos, chunk_of_run, n,
+                disk0, expected_fp, spill_dir, budget, fanin, dtype,
+                width, codec, algorithm, mesh, sink, sink_factory,
+                out_name, tracer, spans)
+        finally:
+            for r in run_infos:
+                runlib.remove_run(r)
+
+
+def _merge_with_recovery(
+    chunks_fn: Any,
+    chunk_elems: int,
+    run_infos: "list[runlib.RunInfo]",
+    chunk_of_run: "list[int]",
+    n: int,
+    disk0: int,
+    expected_fp: Any,
+    spill_dir: str,
+    budget: int,
+    fanin: int,
+    dtype: np.dtype,
+    width: int,
+    codec: Any,
+    algorithm: str,
+    mesh: Any,
+    sink: Any,
+    sink_factory: Any,
+    out_name: str,
+    tracer: Any,
+    spans: Any,
+) -> ExternalResult:
+    """The bounded merge/recovery loop of :func:`_external_core` (split
+    out so the caller owns partition-run cleanup on every exit)."""
+    recoveries = 0
+    merge_passes = 0
+    out: ExternalResult | None = None
+    last_err: str | None = None
+    for attempt in range(MERGE_ATTEMPTS + 1):
+        # the sink is rebuilt PER ATTEMPT: a merge streams chunks
+        # to it before verification can finish, so an attempt that
+        # fails integrity has already fed the sink possibly-bad
+        # data — array/file sinks restart inside _merge_all, and a
+        # streaming caller provides sink_factory(n) so ITS state
+        # (e.g. the CLI's running median probe) restarts too.  A
+        # bare callable sink must be stateless across attempts.
+        attempt_sink = (sink_factory(n) if sink_factory is not None
+                        else sink)
+        try:
+            out, merge_passes = _merge_all(
+                run_infos, expected_fp, n, spill_dir, budget, fanin,
+                dtype, width, codec, attempt_sink, out_name, tracer)
+            break
+        except mergelib.RunIntegrityError as e:
+            # a named bad run: re-spill exactly that slice (an
+            # INTERMEDIATE merge run cannot be re-spilled directly
+            # — blame falls back to scanning the originals)
+            bad = ([e.info] if e.info in run_infos
+                   else [r for r in run_infos
+                         if not runlib.verify_run(r)])
+            last_err = str(e)
+        except SortIntegrityError as e:
+            # output-side mismatch (merge_drop shape): blame by
+            # scanning every run against its sidecar
+            bad = [r for r in run_infos
+                   if not runlib.verify_run(r)]
+            last_err = str(e)
+        if attempt >= MERGE_ATTEMPTS:
+            break
+        recoveries += 1
+        tracer.count("external_recoveries", 1)
+        if spans is not None:
+            spans.event("external.recover",
+                        reason=last_err,
+                        bad_runs=[r.path for r in bad],
+                        attempt=attempt + 1)
+        tracer.verbose(
+            f"external sort integrity failure ({last_err}); "
+            f"re-spilling {len(bad)} run(s) and re-merging")
+        for r in bad:
+            i = run_infos.index(r)
+            ci = chunk_of_run[i]
+            src = next(islice(chunks_fn(chunk_elems), ci, ci + 1))
+            run_infos[i] = _spill_one(ci, np.asarray(src[0], dtype),
+                                      src[1], spill_dir, algorithm,
+                                      mesh, tracer)
+        expected_fp = run_infos[0].fingerprint
+        for r in run_infos[1:]:
+            expected_fp = expected_fp.combine(r.fingerprint)
+    if out is None:
+        raise SortIntegrityError(
+            "external sort produced no verified result after "
+            f"{MERGE_ATTEMPTS} recovery attempt(s): {last_err}")
+
+    out.runs = len(run_infos)
+    out.disk_bytes = disk0
+    out.recoveries = recoveries
+    out.merge_passes = merge_passes
+    tracer.counters["external_runs"] = out.runs
+    tracer.counters["external_disk_bytes"] = out.disk_bytes
+    tracer.counters["external_merge_passes"] = out.merge_passes
+    tracer.counters["external_recoveries"] = recoveries
+    _finish_plan(tracer, out, budget, fanin)
+    return out
+
+
+def _merge_all(
+    run_infos: "list[runlib.RunInfo]",
+    expected_fp: Any,
+    n: int,
+    spill_dir: str,
+    budget: int,
+    fanin: int,
+    dtype: np.dtype,
+    width: int,
+    codec: Any,
+    sink: "str | Callable[[np.ndarray, np.ndarray | None], None]",
+    out_name: str,
+    tracer: Any,
+) -> tuple[ExternalResult, int]:
+    """Fan-in-bounded merge of all runs + the output-side verification
+    (fingerprint vs combined sidecars, boundary-inclusive sortedness).
+    Raises typed integrity errors; never returns unverified bytes."""
+    from mpitest_tpu.models.records import words_to_payload
+
+    spans = _spans(tracer)
+    level = list(run_infos)
+    merge_passes = 0
+    #: intermediate runs created by the fan-in passes — deleted once
+    #: the final pass has consumed them (success OR failure), so a
+    #: multi-pass merge never leaks dataset-sized files
+    created: list[runlib.RunInfo] = []
+    while len(level) > fanin:
+        merge_passes += 1
+        level = _merge_level(level, spill_dir, budget, fanin, dtype,
+                             width, merge_passes, tracer)
+        created.extend(r for r in level if r not in run_infos)
+
+    merge_passes += 1
+    t0 = time.perf_counter()
+    ch = merge_chunk_elems(budget, dtype, width, len(level))
+
+    out_keys: list[np.ndarray] = []
+    out_pay: list[np.ndarray] = []
+    writer: runlib.RunStreamWriter | None = None
+    emit: Callable[[np.ndarray, np.ndarray | None], None]
+    if sink == "array":
+        def emit(k: np.ndarray, p: np.ndarray | None) -> None:
+            out_keys.append(k)
+            if p is not None:
+                out_pay.append(p)
+    elif sink == "file":
+        writer = runlib.RunStreamWriter(spill_dir, out_name, dtype,
+                                        width)
+
+        def emit(k: np.ndarray, p: np.ndarray | None) -> None:
+            writer.append(k, p)
+    elif callable(sink):
+        emit = sink
+    else:
+        raise ValueError(f"unknown sink {sink!r}")
+
+    from mpitest_tpu.models.segmented import lex_sorted_host
+
+    got_fp = None
+    got_n = 0
+    prev_last: tuple[int, ...] | None = None
+    sorted_ok = True
+    try:
+        for kws, pws in mergelib.merge_runs(level, ch):
+            cfp = runlib.run_fingerprint(kws, pws)
+            got_fp = cfp if got_fp is None else got_fp.combine(cfp)
+            m = int(kws[0].size)
+            got_n += m
+            if m:
+                if not lex_sorted_host(kws):
+                    sorted_ok = False
+                first = tuple(int(w[0]) for w in kws)
+                if prev_last is not None and first < prev_last:
+                    sorted_ok = False
+                prev_last = tuple(int(w[-1]) for w in kws)
+            keys_dec = codec.decode(kws)
+            pay_dec = words_to_payload(pws, m, width) if width else None
+            emit(keys_dec, pay_dec)
+    except BaseException:
+        if writer is not None:
+            # close AND delete the partial output run: a failed merge
+            # must not leak a dataset-sized out_<name> file per attempt
+            # (the serve spill tier mints a fresh name per request)
+            runlib.remove_run(writer.close())
+        raise
+    finally:
+        for r in created:
+            runlib.remove_run(r)
+
+    ok = (sorted_ok and got_n == n
+          and (got_fp == expected_fp if got_fp is not None else n == 0))
+    tracer.count("verify_runs", 1)
+    if spans is not None:
+        spans.event("verify", ok=bool(ok), sorted_ok=bool(sorted_ok),
+                    fp_ok=bool(got_fp == expected_fp or n == 0), n=n)
+        spans.record("external.merge", t0, time.perf_counter() - t0,
+                     runs=len(level), n=got_n, final=True,
+                     merge_pass=merge_passes)
+    if not ok:
+        tracer.count("verify_failures", 1)
+        if writer is not None:
+            runlib.remove_run(writer.close())  # see the except above
+        raise SortIntegrityError(
+            f"merged output failed verification (sorted={sorted_ok}, "
+            f"n={got_n}/{n}, fingerprint="
+            f"{'ok' if got_fp == expected_fp else 'MISMATCH'})")
+
+    res = ExternalResult(n, dtype, width, len(run_infos), 0,
+                         merge_passes, 0)
+    if sink == "array":
+        res.keys = (np.concatenate(out_keys) if out_keys
+                    else np.empty(0, dtype))
+        if width:
+            res.payload = (np.concatenate(out_pay) if out_pay
+                           else np.zeros((0, width), np.uint8))
+    elif sink == "file":
+        res.out_run = writer.close()
+    return res, merge_passes
+
+
+def _finish_plan(tracer: Any, res: ExternalResult, budget: int,
+                 fanin: int) -> None:
+    """Record the external plan decision (ISSUE 12): the tier choice,
+    its sizing, and what it actually cost — the serve plan digest's
+    ``spilled: true`` and ``--explain``'s external row come from
+    here."""
+    if not plan_mod.enabled():
+        return
+    plan = plan_mod.SortPlan(algo="external", n=res.n,
+                             dtype=res.dtype.name, ranks=1)
+    plan.decide("external", chosen="spill", trigger="budget",
+                budget=budget, fanin=fanin,
+                payload_width=res.payload_width)
+    plan.actual("external", runs=res.runs, disk_bytes=res.disk_bytes,
+                merge_passes=res.merge_passes,
+                recoveries=res.recoveries)
+    if res.recoveries:
+        plan.bump("external", "recoveries", float(res.recoveries))
+    plan.finalize()
+    tracer.spans.event("sort.plan", **plan.to_attrs())
+    tracer.plan = plan

@@ -1,0 +1,449 @@
+"""Spill-run files: SORTBIN1-framed sorted runs + fingerprint sidecars.
+
+One **run** is a sorted slice of a dataset persisted to disk so the
+external sort (``store/external.py``) can exceed device/host memory:
+
+* ``<name>.run`` — the sorted keys as an ordinary SORTBIN1 file (the
+  exact framing ``utils/io.py`` writes and the native encode engine
+  validates), so every existing reader — ``open_keys_mmap`` zero-copy
+  slicing, the engine-dispatched header check, the CLI — works on a run
+  unchanged.
+* ``<name>.pay`` — the per-record payload bytes (record sorts only):
+  a 16-byte ``SORTPAY1`` header carrying the payload width, then
+  ``n * width`` raw bytes in key order.
+* ``<name>.fpr.json`` — the fingerprint **sidecar**: record count,
+  per-word XOR/sum folds (key words + payload words + the binding mix
+  word, :func:`models.verify.fingerprint_records`) computed from the
+  sorted host words BEFORE the bytes hit disk.  The sidecar is the
+  run's integrity anchor: the merge folds every chunk it reads back and
+  compares at run exhaustion, so bad disk bytes (or the injected
+  ``spill_corrupt`` fault) are caught before they can ship.
+
+This module is the ONE place run files are opened — sortlint rule
+SL014 fences ad-hoc ``open()`` of spill paths everywhere else, so the
+framing/sidecar contract cannot be quietly bypassed.
+
+Typed errors: :class:`RunFormatError` (``ValueError``) for structural
+garbage — bad magic, truncated payload, sidecar/key-count mismatch;
+integrity (fingerprint) failures surface from the merge/external layer
+as ``SortIntegrityError`` so the CLI's exit-code contract (exit 3)
+holds for spilled sorts too.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+
+import numpy as np
+
+from mpitest_tpu import faults
+from mpitest_tpu.models.verify import (Fingerprint, fingerprint_host,
+                                       fingerprint_records)
+from mpitest_tpu.ops.keys import codec_for
+from mpitest_tpu.utils import io as kio
+
+#: Payload-section magic (the key section reuses ``kio.BIN_MAGIC``).
+PAY_MAGIC = b"SORTPAY1"
+PAY_HEADER_LEN = 16
+
+#: Sidecar schema tag.
+FP_SCHEMA = "sortfp1"
+
+
+class RunFormatError(ValueError):
+    """A run file (or its payload/sidecar) is structurally invalid —
+    bad magic, truncation, or a count that disagrees with the sidecar.
+    Always names the offending path."""
+
+
+def _pay_header(width: int) -> bytes:
+    return PAY_MAGIC + int(width).to_bytes(4, "little") + b"\0" * 4
+
+
+@dataclass(frozen=True)
+class RunInfo:
+    """One opened (or freshly written) spill run."""
+
+    path: str                 # the .run key file
+    n: int                    # records in the run
+    dtype: np.dtype
+    payload_width: int        # bytes per record payload (0 = keys only)
+    fingerprint: Fingerprint  # sidecar fold (sorted words, pre-disk)
+    disk_bytes: int           # total bytes on disk (keys + payload)
+
+    @property
+    def pay_path(self) -> str:
+        return self.path + ".pay"
+
+    @property
+    def sidecar_path(self) -> str:
+        return self.path + ".fpr.json"
+
+
+def run_fingerprint(key_words: tuple[np.ndarray, ...],
+                    payload_words: tuple[np.ndarray, ...],
+                    ) -> Fingerprint:
+    """The ONE fold rule for runs: plain per-word fingerprint for bare
+    keys, the record (binding-mix) fingerprint once a payload rides."""
+    if payload_words:
+        return fingerprint_records(key_words, payload_words)
+    return fingerprint_host(key_words)
+
+
+class RunStreamWriter:
+    """Incremental run writer: append already-sorted chunks, fold the
+    fingerprint as they arrive, seal the sidecar at :meth:`close`.
+    The intermediate-merge path writes through this so a merge pass
+    never materializes its output run in host memory;
+    :func:`write_run` is the one-shot convenience on top.
+
+    The ``spill_corrupt`` fault site fires on the FIRST appended chunk
+    (after its fold, before its write) — deterministic placement, same
+    contract as ``faults.maybe_poison_chunk``."""
+
+    def __init__(self, spill_dir: str, name: str, dtype: np.dtype,
+                 payload_width: int = 0) -> None:
+        os.makedirs(spill_dir, exist_ok=True)
+        self.path = os.path.join(spill_dir, f"{name}.run")
+        self.dtype = np.dtype(dtype)
+        self.codec = codec_for(self.dtype)
+        self.payload_width = int(payload_width)
+        self.n = 0
+        self.disk_bytes = 0
+        self._fp: Fingerprint | None = None
+        self._chunks = 0
+        self._kf = open(self.path, "wb")
+        self._kf.write(kio._bin_header(self.dtype))
+        self.disk_bytes += kio.BIN_HEADER_LEN
+        self._pf = None
+        if self.payload_width:
+            self._pf = open(self.path + ".pay", "wb")
+            self._pf.write(_pay_header(self.payload_width))
+            self.disk_bytes += PAY_HEADER_LEN
+
+    def append(self, keys_sorted: np.ndarray,
+               payload_sorted: np.ndarray | None = None) -> None:
+        from mpitest_tpu.models.records import payload_to_words
+
+        keys_sorted = np.ascontiguousarray(
+            np.asarray(keys_sorted, self.dtype).reshape(-1))
+        m = int(keys_sorted.size)
+        if m == 0:
+            return
+        kw = self.codec.encode(keys_sorted)
+        pw: tuple = ()
+        pay = None
+        if self.payload_width:
+            if payload_sorted is None:
+                raise ValueError(
+                    "run declared a payload width but a chunk arrived "
+                    "without payload")
+            pay = np.ascontiguousarray(
+                np.asarray(payload_sorted, np.uint8)).reshape(
+                m, self.payload_width)
+            pw = payload_to_words(pay)
+        cfp = run_fingerprint(kw, pw)
+        self._fp = cfp if self._fp is None else self._fp.combine(cfp)
+        key_bytes = keys_sorted.tobytes()
+        if self._chunks == 0:
+            key_bytes = faults.maybe_corrupt_spill(key_bytes)
+        self._chunks += 1
+        self._kf.write(key_bytes)
+        self.disk_bytes += len(key_bytes)
+        if pay is not None:
+            self._pf.write(pay.tobytes())
+            self.disk_bytes += pay.nbytes
+        self.n += m
+
+    def append_words(self, key_words: tuple[np.ndarray, ...],
+                     payload_words: tuple[np.ndarray, ...]) -> None:
+        """Append a chunk already in encoded-word form (the merge's
+        native currency) — decoded once here for the disk framing."""
+        from mpitest_tpu.models.records import words_to_payload
+
+        keys = self.codec.decode(key_words)
+        pay = None
+        if self.payload_width:
+            pay = words_to_payload(payload_words, int(keys.size),
+                                   self.payload_width)
+        self.append(keys, pay)
+
+    def close(self) -> RunInfo:
+        self._kf.close()
+        if self._pf is not None:
+            self._pf.close()
+        fp = self._fp if self._fp is not None else run_fingerprint(
+            tuple(np.empty(0, np.uint32)
+                  for _ in range(self.codec.n_words)),
+            ())
+        with open(self.path + ".fpr.json", "w") as f:
+            json.dump({"v": FP_SCHEMA, "n": self.n,
+                       "dtype": self.dtype.name,
+                       "payload_width": self.payload_width,
+                       "count": fp.count,
+                       "xors": list(fp.xors), "sums": list(fp.sums)}, f)
+        return RunInfo(self.path, self.n, self.dtype,
+                       self.payload_width, fp, self.disk_bytes)
+
+
+def write_run(spill_dir: str, name: str, keys_sorted: np.ndarray,
+              payload_sorted: np.ndarray | None = None) -> RunInfo:
+    """Persist one sorted run: keys as SORTBIN1, payload (optional) as
+    SORTPAY1, fingerprint sidecar folded from the HOST words before any
+    byte reaches disk.  ``payload_sorted`` is a ``(n, width)`` uint8
+    matrix already permuted into key order (``models/records.py``).
+
+    The ``spill_corrupt`` fault site fires here — after the sidecar
+    fold, before the disk write — so an armed drill produces exactly
+    the bad-disk shape the merge's read-back fold must catch."""
+    keys_sorted = np.asarray(keys_sorted).reshape(-1)
+    width = 0
+    if payload_sorted is not None:
+        pay = np.asarray(payload_sorted, np.uint8)
+        if pay.ndim != 2 or pay.shape[0] != int(keys_sorted.size):
+            raise ValueError(
+                f"payload must be (n, width) uint8; got {pay.shape} for "
+                f"{int(keys_sorted.size)} records")
+        width = int(pay.shape[1])
+    w = RunStreamWriter(spill_dir, name, keys_sorted.dtype, width)
+    w.append(keys_sorted, payload_sorted if width else None)
+    return w.close()
+
+
+def _load_sidecar(path: str) -> tuple[dict, Fingerprint]:
+    sc_path = path + ".fpr.json"
+    try:
+        with open(sc_path) as f:
+            sc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise RunFormatError(
+            f"run sidecar {sc_path!r} unreadable: {e}") from None
+    if not isinstance(sc, dict) or sc.get("v") != FP_SCHEMA:
+        raise RunFormatError(
+            f"run sidecar {sc_path!r}: bad schema tag {sc.get('v')!r} "
+            f"(want {FP_SCHEMA!r})")
+    try:
+        fp = Fingerprint(int(sc["count"]),
+                         tuple(int(v) for v in sc["xors"]),
+                         tuple(int(v) for v in sc["sums"]))
+    except (KeyError, TypeError, ValueError) as e:
+        raise RunFormatError(
+            f"run sidecar {sc_path!r}: malformed fingerprint: {e}"
+        ) from None
+    return sc, fp
+
+
+def open_run(path: str) -> RunInfo:
+    """Open an existing run: validate the SORTBIN1 framing (via the
+    engine-dispatched header check — the native encode engine's
+    read-back path), the payload section, and the sidecar.  Raises
+    :class:`RunFormatError` on any structural problem; fingerprint
+    verification happens at read time (the merge) or via
+    :func:`verify_run`."""
+    sc, fp = _load_sidecar(path)
+    dtype = np.dtype(str(sc.get("dtype", "int32")))
+    try:
+        st = os.stat(path)
+    except OSError as e:
+        raise RunFormatError(f"run file {path!r} unreadable: {e}") from None
+    body = st.st_size - kio.BIN_HEADER_LEN
+    n = int(sc["n"])
+    if body != n * dtype.itemsize:
+        raise RunFormatError(
+            f"run file {path!r}: {body} key bytes on disk but the "
+            f"sidecar says {n} x {dtype.itemsize}-byte records "
+            "(truncated or torn write)")
+    with open(path, "rb") as f:
+        head = f.read(kio.BIN_HEADER_LEN)
+    if head[:8] != kio.BIN_MAGIC:
+        raise RunFormatError(f"run file {path!r} is not SORTBIN1-framed")
+    kio._check_bin_header(head, path, dtype)
+    width = int(sc.get("payload_width", 0))
+    disk = st.st_size
+    if width:
+        pp = path + ".pay"
+        try:
+            pst = os.stat(pp)
+        except OSError as e:
+            raise RunFormatError(
+                f"run payload {pp!r} unreadable: {e}") from None
+        if pst.st_size != PAY_HEADER_LEN + n * width:
+            raise RunFormatError(
+                f"run payload {pp!r}: {pst.st_size} bytes on disk, "
+                f"expected {PAY_HEADER_LEN + n * width} "
+                f"({n} x {width}-byte payloads)")
+        with open(pp, "rb") as f:
+            phead = f.read(PAY_HEADER_LEN)
+        if phead[:8] != PAY_MAGIC or \
+                int.from_bytes(phead[8:12], "little") != width:
+            raise RunFormatError(
+                f"run payload {pp!r}: bad SORTPAY1 header")
+        disk += pst.st_size
+    return RunInfo(path, n, dtype, width, fp, disk)
+
+
+def read_run_chunks(info: RunInfo, chunk_elems: int):
+    """Yield ``(keys_chunk, payload_chunk | None)`` slices of a run in
+    order — keys as zero-copy mmap slices (``kio.open_keys_mmap``, the
+    PR 2 page-in path), payload as mmap-backed ``(m, width)`` views.
+    Bounded memory at any run size."""
+    mm = kio.open_keys_mmap(info.path, info.dtype)
+    if int(mm.size) != info.n:
+        raise RunFormatError(
+            f"run file {info.path!r}: {int(mm.size)} keys on disk, "
+            f"sidecar says {info.n}")
+    pm = None
+    if info.payload_width:
+        pm = np.memmap(info.pay_path, dtype=np.uint8, mode="r",
+                       offset=PAY_HEADER_LEN)
+        pm = pm.reshape(info.n, info.payload_width)
+    if info.n == 0:
+        return
+    chunk_elems = max(1, int(chunk_elems))
+    for i in range(0, info.n, chunk_elems):
+        k = mm[i:i + chunk_elems]
+        p = pm[i:i + chunk_elems] if pm is not None else None
+        yield k, p
+
+
+class InputStage:
+    """Wire→disk staging for the serve spill tier (ISSUE 15): an
+    over-budget request's key/payload bytes stream straight from the
+    socket into spill-dir files — host memory never holds the request —
+    and come back as memmap views the external sort pages in
+    chunk-by-chunk.  Lives here so every spill-path ``open()`` stays
+    inside this module (sortlint SL014)."""
+
+    def __init__(self, spill_dir: str, name: str, dtype: np.dtype,
+                 n: int, payload_width: int = 0) -> None:
+        os.makedirs(spill_dir, exist_ok=True)
+        self.path = os.path.join(spill_dir, f"{name}.spill")
+        self.dtype = np.dtype(dtype)
+        self.n = int(n)
+        self.payload_width = int(payload_width)
+        self._kf = open(self.path, "wb")
+        self._kf.write(kio._bin_header(self.dtype))
+        self._pf = None
+        if self.payload_width:
+            self._pf = open(self.path + ".pay", "wb")
+            self._pf.write(_pay_header(self.payload_width))
+
+    def key_sink(self, chunk: bytes) -> None:
+        self._kf.write(chunk)
+
+    def pay_sink(self, chunk: bytes) -> None:
+        assert self._pf is not None
+        self._pf.write(chunk)
+
+    def abort(self) -> None:
+        """Close + delete the staged files (the request died before
+        dispatch — short read, timeout, rejection)."""
+        self._kf.close()
+        if self._pf is not None:
+            self._pf.close()
+        for p in (self.path, self.path + ".pay"):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+
+    def finish(self) -> tuple[np.ndarray, np.ndarray | None]:
+        """Seal the staged files and return memmap views (keys 1-D,
+        payload ``(n, width)``).  The files are unlinked immediately —
+        the mmaps keep them alive exactly as long as the dispatch needs
+        them, and nothing can leak on any later exit path."""
+        self._kf.close()
+        got = os.path.getsize(self.path) - kio.BIN_HEADER_LEN
+        want = self.n * self.dtype.itemsize
+        if got != want:
+            self.abort()
+            raise RunFormatError(
+                f"staged input {self.path!r}: {got} key bytes, "
+                f"expected {want}")
+        keys = np.memmap(self.path, dtype=self.dtype, mode="r",
+                         offset=kio.BIN_HEADER_LEN)
+        pay = None
+        if self._pf is not None:
+            self._pf.close()
+            pgot = os.path.getsize(self.path + ".pay") - PAY_HEADER_LEN
+            if pgot != self.n * self.payload_width:
+                self.abort()
+                raise RunFormatError(
+                    f"staged payload {self.path + '.pay'!r}: {pgot} "
+                    f"bytes, expected {self.n * self.payload_width}")
+            pay = np.memmap(self.path + ".pay", dtype=np.uint8,
+                            mode="r", offset=PAY_HEADER_LEN)
+            pay = pay.reshape(self.n, self.payload_width)
+        for p in (self.path, self.path + ".pay"):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+        return keys, pay
+
+
+def remove_run(info: RunInfo) -> None:
+    """Best-effort deletion of a run's files (keys, payload, sidecar)
+    — the external driver's cleanup: partition and intermediate runs
+    are dataset-sized and must not outlive the sort that made them."""
+    for p in (info.path, info.pay_path, info.sidecar_path):
+        try:
+            os.unlink(p)
+        except OSError:
+            pass
+
+
+def run_body_views(info: RunInfo,
+                   unlink: bool = False) -> list[memoryview]:
+    """Zero-copy memoryviews of a run's key body (and payload body) —
+    the spill tier's reply source: the wire layer sends these straight
+    to the socket without materializing the merged result.  With
+    ``unlink`` the files are deleted now; the mmaps keep the bytes
+    reachable until the views are dropped."""
+    mm = np.memmap(info.path, dtype=np.uint8, mode="r",
+                   offset=kio.BIN_HEADER_LEN)
+    views = [memoryview(mm)]
+    if info.payload_width:
+        pm = np.memmap(info.pay_path, dtype=np.uint8, mode="r",
+                       offset=PAY_HEADER_LEN)
+        views.append(memoryview(pm))
+    if unlink:
+        for p in (info.path, info.pay_path, info.sidecar_path):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+    return views
+
+
+def verify_run(info: RunInfo, chunk_elems: int = 1 << 20) -> bool:
+    """Full integrity scan of one run: re-fold the on-disk bytes
+    chunk-by-chunk and compare against the sidecar, plus a sortedness
+    sweep across chunk boundaries.  The external driver's blame step —
+    when the merged output disagrees with the combined sidecars, this
+    names the bad run(s)."""
+    from mpitest_tpu.models.records import payload_to_words
+    from mpitest_tpu.models.segmented import lex_sorted_host
+
+    codec = codec_for(info.dtype)
+    fp = None
+    prev_last: np.ndarray | None = None
+    for keys, pay in read_run_chunks(info, chunk_elems):
+        arr = np.array(keys)  # fault the pages in
+        kw = codec.encode(arr)
+        pw = payload_to_words(np.array(pay)) if pay is not None else ()
+        cfp = run_fingerprint(kw, pw)
+        fp = cfp if fp is None else fp.combine(cfp)
+        if arr.size:
+            # boundary-inclusive sortedness: prepend the previous
+            # chunk's last key so a violation across the seam trips too
+            both = (np.concatenate([prev_last, arr])
+                    if prev_last is not None else arr)
+            if not lex_sorted_host(codec.encode(both)):
+                return False
+            prev_last = arr[-1:]
+    if fp is None:  # 0-record run: nothing to fold, nothing to corrupt
+        return info.fingerprint.count == 0
+    return fp == info.fingerprint

@@ -14,8 +14,13 @@ instead hands the ingest pipeline in :mod:`mpitest_tpu.models.ingest` a
 zero-copy view of a SORTBIN1 file whose fixed-size slices page in
 chunk-by-chunk, so parse, encode and host→device DMA overlap with
 bounded host memory.  Text files parse through the multi-threaded
-chunked block reader (:func:`iter_key_chunks`) but materialize once —
-the pipeline's shard bounds need the total key count up front.  The
+chunked block reader (:func:`iter_key_chunks`) but materialize once on
+the IN-MEMORY path — the pipeline's shard bounds need the total key
+count up front.  The OUT-OF-CORE path (ISSUE 15,
+``store/external.external_sort_file``) has no such need: it consumes
+:func:`iter_key_chunks` directly, spilling each parsed chunk straight
+to a sorted run, so a text input larger than ``SORT_MEM_BUDGET`` peaks
+at chunk-sized host memory instead of the whole file.  The
 ``SORT_INGEST_CHUNK`` / ``SORT_INGEST_THREADS`` knobs below are the one
 canonical reader for both the CLI and the library.
 """

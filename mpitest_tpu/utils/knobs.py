@@ -681,6 +681,29 @@ register("SORT_SERVE_COMPLETION_TIMEOUT_S", "float", 600.0,
          "request to complete before failing it typed 'internal'.",
          _float_gt0("SORT_SERVE_COMPLETION_TIMEOUT_S"))
 
+# Out-of-core external-sort knobs (ISSUE 15: mpitest_tpu/store/).  The
+# budget is deliberately forceable far below real device/host memory so
+# the whole spill/merge path is CPU-testable; 0 (the default) disables
+# the external path entirely — nothing spills unless asked to.
+
+register("SORT_SPILL_DIR", "path", None,
+         "a writable directory path (default: a per-process tmp dir)",
+         "Directory spill runs are staged in (store/runs.py owns every "
+         "read/write of it — sortlint SL014).",
+         _passthrough)
+register("SORT_MEM_BUDGET", "int", 0, "an integer >= 0 (0 = unlimited)",
+         "Host/device byte budget the external sort partitions against; "
+         "inputs above it spill to sorted runs and k-way merge back.",
+         _int("SORT_MEM_BUDGET", lo=0))
+register("SORT_MERGE_FANIN", "int", 16, "an integer >= 2",
+         "Maximum runs merged per k-way merge pass; more runs merge in "
+         "multiple passes through intermediate runs.",
+         _int("SORT_MERGE_FANIN", lo=2))
+register("SORT_SERVE_SPILL", "enum", "auto", "auto | off",
+         "Route serve requests larger than SORT_SERVE_MAX_BYTES to the "
+         "out-of-core spill tier instead of a typed 'bytes' rejection.",
+         _enum("SORT_SERVE_SPILL", ("auto", "off")))
+
 # Bench-driver knobs (bench.py).
 
 
@@ -728,6 +751,10 @@ register("BENCH_PLANNER", "enum", "auto", "auto | off",
          "Emit the planner_mix_mkeys_per_s bench row (the adversarial "
          "mix of bench/planner_selftest.py, planner pinned off).",
          _enum("BENCH_PLANNER", ("auto", "off")))
+register("BENCH_EXTERNAL", "enum", "auto", "auto | off",
+         "Emit the external_sort_mkeys_per_s bench row (out-of-core "
+         "spill+merge under a forced SORT_MEM_BUDGET).",
+         _enum("BENCH_EXTERNAL", ("auto", "off")))
 
 # Bench-script knobs (bench/*.py probes and batteries).
 

@@ -159,6 +159,24 @@ METRICS: dict[str, tuple[str, str]] = {
     "sort_serve_batch_window_ms": (
         "gauge", "Current (possibly auto-tuned) serve batching window "
                  "in milliseconds."),
+    # out-of-core external sort (ISSUE 15): spill/merge volume and the
+    # integrity-recovery tally, fed from external.* span closes; the
+    # spilled-request counter is written by the serve spill tier.
+    "sort_external_runs_total": (
+        "counter", "Spill runs written by the external sort."),
+    "sort_external_spill_bytes_total": (
+        "counter", "Bytes written to spill runs (keys + payload + "
+                   "framing)."),
+    "sort_external_merge_seconds_total": (
+        "counter", "Wall seconds spent in k-way merge passes."),
+    "sort_external_recoveries_total": (
+        "counter", "External-sort integrity recoveries (bad run "
+                   "re-spilled / merge re-ran before a verified "
+                   "result)."),
+    "sort_external_spilled_requests_total": (
+        "counter", "Serve requests routed to the out-of-core spill "
+                   "tier (payload larger than the admission byte "
+                   "bound)."),
 }
 
 _HISTOGRAM_BUCKETS: dict[str, tuple[float, ...]] = {
@@ -485,6 +503,15 @@ class SpanMetricsBridge:
                     float(attrs.get("compile_s", 0.0) or 0.0))
         elif name == "serve.profile":
             metrics.counter("sort_profile_captures_total").inc(1)
+        elif name == "external.run":
+            metrics.counter("sort_external_runs_total").inc(1)
+            metrics.counter("sort_external_spill_bytes_total").inc(
+                float(attrs.get("bytes", 0) or 0))
+        elif name == "external.merge":
+            metrics.counter(
+                "sort_external_merge_seconds_total").inc(dt)
+        elif name == "external.recover":
+            metrics.counter("sort_external_recoveries_total").inc(1)
         elif name == "serve.deadline":
             metrics.counter("sort_serve_deadline_exceeded_total").inc(
                 1, stage=str(attrs.get("stage", "?")))

@@ -94,6 +94,16 @@ SPAN_NAMES: dict[str, str] = {
     # (models/plan.py is the registered decision vocabulary, SL005)
     "sort.plan": ("one finished plan record (algo, regret, decisions, "
                   "profile) — report.py --explain and /varz consume it"),
+    # store/ — out-of-core external sort (ISSUE 15)
+    "external.run": ("one spill run written (run, n, bytes, dtype, "
+                     "payload_width) — partition chunk sorted + "
+                     "persisted with its fingerprint sidecar"),
+    "external.merge": ("one k-way merge pass (runs, n, merge_pass, "
+                       "final) — intermediate passes stream into a "
+                       "run, the final pass into the caller's sink"),
+    "external.recover": ("external-sort integrity recovery point event "
+                         "(reason, bad_runs, attempt) — blamed runs "
+                         "re-spilled from source before the re-merge"),
     # models/ingest.py — streamed pipeline stages (ISSUE 2)
     "ingest.parse": "parse/materialize one host chunk",
     "ingest.encode": "codec-encode one chunk (worker pool)",
@@ -132,6 +142,11 @@ SERVE_HEDGE_SPAN = "serve.hedge"
 #: Plan-provenance name (ISSUE 12): the decision record report.py
 #: --explain renders and the /varz decision snapshot aggregates.
 PLAN_SPAN = "sort.plan"
+
+#: Out-of-core external sort names (ISSUE 15).
+EXTERNAL_RUN_SPAN = "external.run"
+EXTERNAL_MERGE_SPAN = "external.merge"
+EXTERNAL_RECOVER_SPAN = "external.recover"
 
 #: Request-trace attributes (ISSUE 10): the wire layer mints one
 #: ``trace_id`` per request (echoed in the response) and the dispatch

@@ -1,0 +1,373 @@
+"""Out-of-core store tests (ISSUE 15): run format, k-way merge,
+external sort, record sorts, and the serve payload/spill wire path."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from mpitest_tpu.models import records
+from mpitest_tpu.models.supervisor import SortIntegrityError
+from mpitest_tpu.store import external
+from mpitest_tpu.store import merge as mergelib
+from mpitest_tpu.store import runs as runlib
+from mpitest_tpu.utils import knobs
+
+ALL_DTYPES = ("int32", "uint32", "int64", "uint64", "float32", "float64")
+
+
+def _keys(rng, dtype, n):
+    dt = np.dtype(dtype)
+    if dt.kind == "f":
+        return (rng.standard_normal(n) * 10.0
+                ** rng.integers(-10, 10, n)).astype(dt)
+    info = np.iinfo(dt)
+    return rng.integers(info.min, info.max, n, dtype=dt)
+
+
+def _merge_to_array(infos, chunk=97):
+    codec = runlib.codec_for(infos[0].dtype)
+    kparts, pparts = [], []
+    for kws, pws in mergelib.merge_runs(infos, chunk):
+        kparts.append(codec.decode(kws))
+        if pws:
+            pparts.append(records.words_to_payload(
+                pws, int(kws[0].size), infos[0].payload_width))
+    keys = (np.concatenate(kparts) if kparts
+            else np.empty(0, infos[0].dtype))
+    pay = np.concatenate(pparts) if pparts else None
+    return keys, pay
+
+
+# ---------------------------------------------------------------- runs
+
+@pytest.mark.parametrize("dtype", ALL_DTYPES)
+def test_run_roundtrip_and_sidecar(tmp_path, rng, dtype):
+    keys = np.sort(_keys(rng, dtype, 5000))
+    info = runlib.write_run(str(tmp_path), f"r_{dtype}", keys)
+    ri = runlib.open_run(info.path)
+    assert ri.n == 5000 and ri.dtype == np.dtype(dtype)
+    assert ri.fingerprint == info.fingerprint
+    back = np.concatenate([np.array(k) for k, _p in
+                           runlib.read_run_chunks(ri, 700)])
+    assert np.array_equal(back, keys)
+    assert runlib.verify_run(ri, chunk_elems=512)
+
+
+def test_run_roundtrip_with_payload(tmp_path, rng):
+    n = 3000
+    keys = _keys(rng, np.int64, n)
+    pay = rng.integers(0, 256, (n, 5), dtype=np.uint8)
+    order = np.argsort(keys, kind="stable")
+    info = runlib.write_run(str(tmp_path), "rp", keys[order], pay[order])
+    ri = runlib.open_run(info.path)
+    assert ri.payload_width == 5
+    ks, ps = [], []
+    for k, p in runlib.read_run_chunks(ri, 999):
+        ks.append(np.array(k))
+        ps.append(np.array(p))
+    assert np.array_equal(np.concatenate(ks), keys[order])
+    assert np.array_equal(np.concatenate(ps), pay[order])
+    assert runlib.verify_run(ri)
+
+
+def test_truncated_run_is_typed(tmp_path, rng):
+    keys = np.sort(_keys(rng, np.int32, 1000))
+    info = runlib.write_run(str(tmp_path), "t", keys)
+    with open(info.path, "r+b") as f:   # sortlint: disable=SL014 -- the test IS the corruption drill
+        f.truncate(os.path.getsize(info.path) - 8)
+    with pytest.raises(runlib.RunFormatError, match="truncated|bytes"):
+        runlib.open_run(info.path)
+
+
+def test_garbage_sidecar_is_typed(tmp_path, rng):
+    keys = np.sort(_keys(rng, np.int32, 100))
+    info = runlib.write_run(str(tmp_path), "g", keys)
+    with open(info.sidecar_path, "w") as f:  # sortlint: disable=SL014 -- corruption drill
+        json.dump({"v": "wrong"}, f)
+    with pytest.raises(runlib.RunFormatError, match="schema"):
+        runlib.open_run(info.path)
+
+
+def test_corrupt_run_fails_verify_and_merge(tmp_path, rng):
+    keys = np.sort(_keys(rng, np.int32, 4000))
+    info = runlib.write_run(str(tmp_path), "c", keys)
+    with open(info.path, "r+b") as f:  # sortlint: disable=SL014 -- corruption drill
+        f.seek(runlib.kio.BIN_HEADER_LEN + 40)
+        f.write(b"\xff\xff\xff\xfe")
+    ri = runlib.open_run(info.path)
+    assert not runlib.verify_run(ri)
+    with pytest.raises(mergelib.RunIntegrityError):
+        for _ in mergelib.merge_runs([ri], 512):
+            pass
+
+
+# --------------------------------------------------------------- merge
+
+def test_merge_adversarial_shapes(tmp_path, rng):
+    cases = {
+        "dup_heavy": [rng.integers(0, 5, 4000, dtype=np.int32)
+                      for _ in range(3)],
+        "presorted": [np.arange(i * 1000, (i + 1) * 1000,
+                                dtype=np.int32) for i in range(4)],
+        "n_lt_runs": [np.array([i], dtype=np.int32) for i in range(6)],
+        "empty_runs": [np.empty(0, np.int32),
+                       rng.integers(-50, 50, 300, dtype=np.int32),
+                       np.empty(0, np.int32)],
+    }
+    for name, arrays in cases.items():
+        infos = [runlib.write_run(str(tmp_path), f"{name}_{i}",
+                                  np.sort(a))
+                 for i, a in enumerate(arrays)]
+        got, _ = _merge_to_array(infos, chunk=37)
+        want = np.sort(np.concatenate(arrays)) if arrays else \
+            np.empty(0, np.int32)
+        assert np.array_equal(got, want), name
+
+
+def test_merge_is_stable_across_runs(tmp_path, rng):
+    """Equal keys merge in (run, in-run position) order — the exact
+    order the in-memory stable sort of the concatenated chunks gives,
+    pinned via payloads that tag each record's origin."""
+    n, runs_n = 2400, 4
+    keys = rng.integers(0, 7, n, dtype=np.int32)   # heavy ties
+    pay = np.arange(n, dtype=np.uint64).view(np.uint8).reshape(n, 8)
+    infos = []
+    per = n // runs_n
+    for i in range(runs_n):
+        k = keys[i * per:(i + 1) * per]
+        p = pay[i * per:(i + 1) * per]
+        order = np.argsort(k, kind="stable")
+        infos.append(runlib.write_run(str(tmp_path), f"s{i}",
+                                      k[order], p[order]))
+    got_k, got_p = _merge_to_array(infos, chunk=53)
+    order = np.argsort(keys, kind="stable")
+    assert np.array_equal(got_k, keys[order])
+    assert np.array_equal(got_p, pay[order])
+
+
+# ------------------------------------------------------------- records
+
+def test_payload_matrix_forms(rng):
+    n = 10
+    m = rng.integers(0, 256, (n, 3), dtype=np.uint8)
+    assert np.array_equal(records.as_payload_matrix(m, n), m)
+    assert np.array_equal(
+        records.as_payload_matrix(m.tobytes(), n), m)
+    ids = np.arange(n, dtype=np.uint64)
+    assert records.as_payload_matrix(ids, n).shape == (n, 8)
+    with pytest.raises(ValueError, match="multiple"):
+        records.as_payload_matrix(b"12345", 2)
+    with pytest.raises(ValueError, match="one element per record"):
+        records.as_payload_matrix(np.arange(5), 3)
+
+
+def test_payload_words_roundtrip(rng):
+    for width in (1, 3, 4, 7, 8):
+        pay = rng.integers(0, 256, (100, width), dtype=np.uint8)
+        words = records.payload_to_words(pay)
+        assert len(words) == records.payload_width_words(width)
+        back = records.words_to_payload(words, 100, width)
+        assert np.array_equal(back, pay)
+
+
+@pytest.mark.parametrize("dtype", ("int32", "uint64", "float64"))
+def test_sort_records_matches_stable_argsort(rng, dtype):
+    keys = _keys(rng, dtype, 3000)
+    keys[100:200] = keys[0]  # force ties: the stability contract
+    pay = rng.integers(0, 256, (3000, 6), dtype=np.uint8)
+    sk, sp = records.sort_records(keys, pay)
+    order = np.argsort(keys, kind="stable")
+    assert np.array_equal(sk, keys[order])
+    assert np.array_equal(sp, pay[order])
+
+
+def test_api_sort_payload_entry(rng):
+    from mpitest_tpu.models import api
+
+    keys = _keys(rng, np.int32, 1000)
+    pay = rng.integers(0, 256, (1000, 4), dtype=np.uint8)
+    sk, sp = api.sort(keys, payload=pay)
+    order = np.argsort(keys, kind="stable")
+    assert np.array_equal(sk, keys[order])
+    assert np.array_equal(sp, pay[order])
+
+
+def test_record_fingerprint_catches_pairing_swap(rng):
+    """The binding mix word: swapping two records' payloads preserves
+    both per-word multisets but must move the record fingerprint."""
+    from mpitest_tpu.models import verify as vfy
+
+    keys = np.arange(100, dtype=np.int32)
+    pay = rng.integers(0, 256, (100, 4), dtype=np.uint8)
+    kw = runlib.codec_for(np.dtype(np.int32)).encode(keys)
+    pw = records.payload_to_words(pay)
+    fp = vfy.fingerprint_records(kw, pw)
+    swapped = pay.copy()
+    swapped[[0, 1]] = swapped[[1, 0]]
+    fp2 = vfy.fingerprint_records(
+        kw, records.payload_to_words(swapped))
+    assert fp != fp2
+
+
+# ------------------------------------------------------------ external
+
+def test_external_sort_matches_in_memory(tmp_path, rng):
+    from mpitest_tpu.models import api
+
+    x = _keys(rng, np.int32, 30_000)
+    res = external.external_sort(x, budget=1 << 15,
+                                 spill_dir=str(tmp_path))
+    assert res.runs >= 4
+    assert np.array_equal(res.keys, api.sort(x))
+    assert np.array_equal(res.keys, np.sort(x))
+
+
+def test_external_sort_file_sink(tmp_path, rng):
+    x = _keys(rng, np.int32, 20_000)
+    res = external.external_sort(x, budget=1 << 15,
+                                 spill_dir=str(tmp_path), sink="file",
+                                 out_name="out")
+    assert res.out_run is not None and res.out_run.n == x.size
+    views = runlib.run_body_views(res.out_run, unlink=True)
+    got = np.frombuffer(views[0], np.int32)
+    assert np.array_equal(got, np.sort(x))
+    assert not os.path.exists(res.out_run.path)  # unlinked
+
+
+def test_external_sort_text_file_streams(tmp_path, rng):
+    from mpitest_tpu.utils.io import write_keys_text
+
+    x = _keys(rng, np.int32, 20_000)
+    p = tmp_path / "keys.txt"
+    write_keys_text(str(p), x)
+    res = external.external_sort_file(str(p), np.int32,
+                                      budget=1 << 15,
+                                      spill_dir=str(tmp_path / "s"))
+    assert res.runs >= 2
+    assert np.array_equal(res.keys, np.sort(x))
+
+
+def test_external_recovery_and_typed_failure(tmp_path, rng):
+    from mpitest_tpu import faults
+
+    x = _keys(rng, np.int32, 20_000)
+    reg = faults.FaultRegistry("merge_drop", seed=3)
+    faults.install(reg)
+    try:
+        res = external.external_sort(x, budget=1 << 15,
+                                     spill_dir=str(tmp_path / "a"))
+        assert np.array_equal(res.keys, np.sort(x))
+        assert reg.injected == 1 and res.recoveries == 1
+    finally:
+        faults.install(None)
+    reg = faults.FaultRegistry("spill_corrupt:inf", seed=3)
+    faults.install(reg)
+    try:
+        with pytest.raises(SortIntegrityError):
+            external.external_sort(x, budget=1 << 15,
+                                   spill_dir=str(tmp_path / "b"))
+    finally:
+        faults.install(None)
+
+
+def test_external_requires_budget(rng):
+    with pytest.raises(ValueError, match="budget"):
+        external.external_sort(np.arange(10, dtype=np.int32), budget=0)
+    with pytest.raises(ValueError, match="fan-in"):
+        external.external_sort(np.arange(10, dtype=np.int32),
+                               budget=1 << 20, fanin=1)
+
+
+# ----------------------------------------------------------- serve wire
+
+def test_serve_payload_and_spill_over_the_wire(tmp_path, rng):
+    """The acceptance pair over a REAL socket: a payload_bytes record
+    request round-trips bit-identical, and an over-admission request
+    succeeds through the spill tier with ``spilled: true``."""
+    from mpitest_tpu.serve.client import ServeClient
+    from mpitest_tpu.serve.server import ServerCore, SortServer
+
+    with knobs.scoped_env(SORT_SERVE_MAX_BYTES=str(1 << 14),
+                          SORT_SERVE_BATCH_WINDOW_MS="0",
+                          SORT_MEM_BUDGET=str(1 << 13),
+                          SORT_SPILL_DIR=str(tmp_path / "spill"),
+                          SORT_SERVE_PREWARM="off"):
+        core = ServerCore()
+        srv = SortServer(core, "127.0.0.1", 0)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            with ServeClient("127.0.0.1", srv.bound_port,
+                             timeout=120.0) as c:
+                n = 500
+                keys = _keys(rng, np.int32, n)
+                pay = rng.integers(0, 256, (n, 8), dtype=np.uint8)
+                order = np.argsort(keys, kind="stable")
+                rep = c.sort(keys, payload=pay)
+                assert rep.ok and not rep.spilled
+                assert np.array_equal(rep.arr, keys[order])
+                assert np.array_equal(rep.payload, pay[order])
+
+                big = _keys(rng, np.int32, 8000)  # 32 KB > 16 KiB
+                rep = c.sort(big)
+                assert rep.ok and rep.spilled
+                assert np.array_equal(rep.arr, np.sort(big))
+                assert rep.plan is not None and rep.plan.get("spilled")
+        finally:
+            srv.shutdown()
+            srv.server_close()
+            core.drain_and_stop(timeout=10.0)
+
+
+def test_serve_spill_off_keeps_bytes_rejection(tmp_path, rng):
+    from mpitest_tpu.serve.server import ServerCore
+
+    with knobs.scoped_env(SORT_SERVE_MAX_BYTES=str(1 << 12),
+                          SORT_SERVE_SPILL="off",
+                          SORT_SERVE_BATCH_WINDOW_MS="0"):
+        core = ServerCore()
+        try:
+            big = _keys(rng, np.int32, 4000)
+            status, detail, attrs = core.execute(big)
+            assert status == "backpressure"
+            assert attrs.get("reject") == "bytes"
+        finally:
+            core.drain_and_stop(timeout=10.0)
+
+
+def test_wire_bad_payload_bytes_is_typed(rng):
+    import io
+
+    from mpitest_tpu.serve.server import ServerCore
+
+    core = ServerCore()
+    try:
+        hdr = {"v": "sortserve.v1", "dtype": "int32", "n": 4,
+               "payload_bytes": -1}
+        resp, _pay, keep = core.handle_wire(
+            json.dumps(hdr).encode() + b"\n", io.BytesIO(b""))
+        assert not resp["ok"] and resp["error"] == "bad_request"
+        assert "payload_bytes" in resp["detail"]
+    finally:
+        core.drain_and_stop(timeout=10.0)
+
+
+# --------------------------------------------------------------- knobs
+
+def test_external_knob_validation():
+    with knobs.scoped_env(SORT_MEM_BUDGET="-3"):
+        with pytest.raises(ValueError, match="SORT_MEM_BUDGET"):
+            knobs.get("SORT_MEM_BUDGET")
+    with knobs.scoped_env(SORT_MERGE_FANIN="1"):
+        with pytest.raises(ValueError, match="SORT_MERGE_FANIN"):
+            knobs.get("SORT_MERGE_FANIN")
+    with knobs.scoped_env(SORT_SERVE_SPILL="yes"):
+        with pytest.raises(ValueError, match="SORT_SERVE_SPILL"):
+            knobs.get("SORT_SERVE_SPILL")
+    assert knobs.get("SORT_MERGE_FANIN") == 16
+    assert knobs.get("SORT_SERVE_SPILL") == "auto"

@@ -158,7 +158,7 @@ def test_plan_registry_vocabulary():
     assert all(doc for doc in plan_mod.PLAN_DECISIONS.values())
     assert {"algo", "cap", "restage", "engine", "exchange_engine",
             "passes", "ladder", "batch",
-            "planner"} == set(plan_mod.PLAN_DECISIONS)
+            "planner", "external"} == set(plan_mod.PLAN_DECISIONS)
 
 
 def test_metrics_registry_vocabulary():
@@ -229,6 +229,28 @@ def test_sl013_pallas_call_home_and_interpret():
               "                              interpret=interpret)(y)\n"
               "    return inner(x)\n")
     assert lint_source(nested, "mpitest_tpu/ops/x.py") == []
+
+
+def test_sl014_spill_file_fence():
+    """ISSUE 15: run-file reads/writes live only in store/runs.py —
+    ad-hoc open()/np.memmap of a spill artifact bypasses the SORTBIN1
+    framing checks and the fingerprint sidecar fold."""
+    lit = 'def f() -> None:\n    open("/tmp/spill/r0.run", "rb")\n'
+    assert rules_of(lint_source(lit, "mpitest_tpu/serve/x.py")) == \
+        ["SL014"]
+    fstr = ('def f(d: str) -> None:\n'
+            '    open(f"{d}/part.fpr.json")\n')
+    assert rules_of(lint_source(fstr, "bench/x.py")) == ["SL014"]
+    mm = ('import numpy as np\n'
+          'def f(info: object) -> None:\n'
+          '    np.memmap(info.pay_path, dtype=np.uint8)\n')
+    assert rules_of(lint_source(mm, "mpitest_tpu/store/external.py")) \
+        == ["SL014"]
+    # the home module is exempt — it IS the fence
+    assert lint_source(lit, "mpitest_tpu/store/runs.py") == []
+    # unrelated open() stays legal everywhere
+    ok = 'def f() -> None:\n    open("/tmp/keys.bin", "rb")\n'
+    assert lint_source(ok, "mpitest_tpu/serve/x.py") == []
 
 
 def test_sl040_typed_core_annotations():

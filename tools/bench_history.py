@@ -46,6 +46,9 @@ COLUMNS: tuple[tuple[str, str, str, bool], ...] = (
     # rising regret is a planner/negotiation regression even when the
     # throughput column still looks fine
     ("plan_regret", "plan regret", "x", False),
+    # out-of-core external sort (ISSUE 15): spill+merge throughput
+    # under a forced memory budget; pre-r06 rounds render "-"
+    ("external_mkeys_per_s", "external", "Mkeys/s", True),
 )
 
 #: String-valued trajectory columns (ISSUE 13): rendered verbatim, no
@@ -116,6 +119,10 @@ def load_run(path: Path) -> dict[str, object]:
             elif name.endswith("_8dev"):
                 put("cap_saving_pct", obj.get("cap_saving_pct"))
                 put("plan_regret", obj.get("plan_regret"))
+            elif name.startswith("external_sort_"):
+                # ISSUE 15: the out-of-core row — never folded into
+                # the in-memory sort column
+                put("external_mkeys_per_s", obj["value"])
             else:
                 put("sort_row_mkeys_per_s", obj["value"])
                 if "plan_regret" not in vals:

@@ -602,6 +602,70 @@ register(Rule(
     _check_pallas_home))
 
 
+# ---------------------------------------------------------------- SL014
+
+#: The ONE module allowed to open spill/run files (ISSUE 15): the
+#: SORTBIN1-framed run format, its payload section and its fingerprint
+#: sidecar are a contract — ad-hoc reads/writes elsewhere would bypass
+#: the framing checks and the sidecar fold that make a bad run file
+#: loud instead of silently wrong.
+_RUN_FILE_HOME = "mpitest_tpu/store/runs.py"
+
+#: File-name suffixes that identify a spill artifact (the run format's
+#: whole on-disk surface: keys, payload, sidecar, wire staging).
+_RUN_SUFFIXES = (".run", ".pay", ".fpr.json", ".spill")
+
+#: RunInfo path accessors — passing one to open()/np.memmap is the
+#: other ad-hoc bypass shape.
+_RUN_PATH_ATTRS = ("pay_path", "sidecar_path")
+
+_OPENERS = ("open", "memmap")
+
+
+def _spill_literalish(node: ast.AST) -> bool:
+    """True when an argument expression names a spill artifact: a
+    string constant (or f-string tail) ending in a run suffix, or a
+    RunInfo path accessor."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value.endswith(_RUN_SUFFIXES)
+    if isinstance(node, ast.JoinedStr) and node.values:
+        last = node.values[-1]
+        if isinstance(last, ast.Constant) and isinstance(last.value, str):
+            return last.value.endswith(_RUN_SUFFIXES)
+    if isinstance(node, ast.Attribute) and node.attr in _RUN_PATH_ATTRS:
+        return True
+    return False
+
+
+def _check_run_file_fence(path: str, src: str,
+                          tree: ast.AST) -> list[Finding]:
+    p = path.replace("\\", "/")
+    if p.endswith(_RUN_FILE_HOME):
+        return []
+    out = []
+    for node, _stk in _walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _attr_chain(node.func).split(".")[-1] not in _OPENERS:
+            continue
+        if any(_spill_literalish(a) for a in node.args):
+            out.append(Finding(
+                "SL014", path, node.lineno,
+                "ad-hoc open()/memmap of a spill-run artifact "
+                "(.run/.pay/.fpr.json/.spill) outside store/runs.py — "
+                "run files carry SORTBIN1 framing + a fingerprint "
+                "sidecar; go through store.runs (write_run/open_run/"
+                "read_run_chunks/run_body_views) so a bad file stays "
+                "a typed, loud error"))
+    return out
+
+
+register(Rule(
+    "SL014", "spill-file-fence",
+    "spill-run files are read/written only via mpitest_tpu/store/runs.py",
+    _check_run_file_fence))
+
+
 # ---------------------------------------------------------------- SL020
 
 def _parse_sites(faults_path: Path) -> list[str]:

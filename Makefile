@@ -102,7 +102,8 @@ PYTHON ?= python3
 
 .PHONY: test native native-encode chip-test telemetry-selftest \
     ingest-selftest fault-selftest multichip-selftest serve-selftest \
-    chaos-serve-selftest planner-selftest external-selftest lint \
+    chaos-serve-selftest planner-selftest external-selftest \
+    doctor-selftest lint \
     cwarn-check typecheck tidy-check knob-docs sanitize-selftest \
     bench-history clean
 
@@ -164,6 +165,10 @@ telemetry-selftest:
 	# asserts the serve-side half (plan spans registered, regret
 	# metrics scraped, negotiate-off > negotiated cap regret)
 	$(PYTHON) -m mpitest_tpu.report --explain $(TELEMETRY_TMP)/trace.jsonl
+	# doctor leg (ISSUE 16): the same CLI trace renders through the
+	# pathology diagnoser; diagnosis is a report, not a gate, so a
+	# healthy run exits 0 with zero findings
+	$(PYTHON) -m mpitest_tpu.report --doctor $(TELEMETRY_TMP)/trace.jsonl
 	JAX_PLATFORMS=cpu \
 	    $(PYTHON) -u bench/telemetry_live_selftest.py \
 	    --out $(TELEMETRY_TMP)/live
@@ -242,6 +247,24 @@ external-selftest:
 	    $(PYTHON) -u bench/external_selftest.py
 	$(PYTHON) -m mpitest_tpu.report --check --require-registered-spans \
 	    $(EXTERNAL_TMP)/trace.jsonl
+
+# The sort-doctor gate (ISSUE 16) — see bench/doctor_selftest.py.
+# Every DOCTOR_RULES pathology is planted deterministically and must be
+# diagnosed EXACTLY (right rule, evidence cited, knob suggested); a
+# real clean run must produce zero findings; the in-process sentinel
+# cells prove the full alert loop (serve.alert span -> bridged
+# sort_alerts_total -> flight-recorder dump that itself passes the
+# schema check).  The final report passes re-validate a planted trace
+# and render its diagnosis through the public --doctor CLI.
+DOCTOR_TMP := /tmp/mpitest_doctor_selftest
+doctor-selftest:
+	rm -rf $(DOCTOR_TMP) && mkdir -p $(DOCTOR_TMP)
+	JAX_PLATFORMS=cpu \
+	    $(PYTHON) -u bench/doctor_selftest.py --out $(DOCTOR_TMP)
+	$(PYTHON) -m mpitest_tpu.report --check --require-registered-spans \
+	    $(DOCTOR_TMP)/skew_imbalance.jsonl \
+	    $(DOCTOR_TMP)/deadline_burn.jsonl
+	$(PYTHON) -m mpitest_tpu.report --doctor $(DOCTOR_TMP)/skew_imbalance.jsonl
 
 # The wire-chaos gate (ISSUE 11) — see bench/chaos_serve_selftest.py.
 # Real servers behind the chaos TCP proxy on a plain 1-device CPU

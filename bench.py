@@ -129,6 +129,7 @@ def _measure_multichip(algo: str, dtype: np.dtype, log2n: int,
     from mpitest_tpu.models.api import (SortRetryExhausted,
                                         checked_device_put, sort)
     from mpitest_tpu.parallel.mesh import key_sharding, make_mesh
+    from mpitest_tpu.utils import knobs, timeline
     from mpitest_tpu.utils.io import generate
     from mpitest_tpu.utils.metrics import Metrics
     from mpitest_tpu.utils.trace import Tracer
@@ -198,6 +199,10 @@ def _measure_multichip(algo: str, dtype: np.dtype, log2n: int,
         # ISSUE 14: planner column (pinned off on measured rows).
         "planner": str(knobs.get("SORT_PLANNER")),
     }
+    # ISSUE 16: the timeline fold's trajectory scalars — worst per-pass
+    # straggler (max/median rank bytes) and the dominant phase — from
+    # the LAST timed run's spans; absent keys render "-" downstream.
+    row.update(timeline.bench_fold(tracer.spans.spans))
     metrics = Metrics(config={"platform": platform, "algo": algo,
                               "log2n": log2n, "dtype": dtype.name,
                               "devices": MULTICHIP_DEVICES})
@@ -823,6 +828,12 @@ def main() -> None:
         out["encode_gb_per_s"] = encode_gbs
     if ingest_ratio is not None:
         out["ingest_ratio"] = ingest_ratio
+    # ISSUE 16: the timeline fold's trajectory scalars (straggler
+    # factor, critical-path phase) from the last timed run's spans —
+    # single-device runs carry no exchange balance, so the straggler
+    # key is usually absent here and the bench-history cell renders "-".
+    from mpitest_tpu.utils import timeline
+    out.update(timeline.bench_fold(tracer.spans.spans))
     # Plan digest (ISSUE 12): decision provenance pinned in the row so
     # the trajectory captures what was DECIDED, not only what it scored.
     if "plan_regret" in tracer.counters:

@@ -18,7 +18,9 @@ spawned server:
 2. **/metrics** — scrapeable while serving; exposition format valid;
    every exported name registered in ``utils/metrics_live.py``;
    request counters reconcile EXACTLY with the client's own accounting.
-3. **/healthz, /varz, /flightrecorder, /profile** — live and sane.
+3. **/healthz, /varz, /flightrecorder, /profile, /alerts** — live and
+   sane; the sentinel's alert total reconciles with
+   ``sort_alerts_total`` and stays zero on this healthy run.
 4. **flight recorder** — a fault-injected typed error leaves a dump
    artifact that ``report.py --check`` accepts; the ``/flightrecorder``
    snapshot parses as span JSONL.
@@ -261,6 +263,31 @@ def run(out: Path) -> int:
         pf = json.loads(http_get(srv.metrics_port, "/profile?n=1"))
         if pf.get("armed", 0) < 1:
             fails.append(f"/profile did not arm: {pf}")
+        # -- /alerts (ISSUE 16): the sentinel is on by default, so the
+        # endpoint must report enabled with the rolling series visible,
+        # every raised rule must come from the registered vocabulary,
+        # and the alert total must reconcile EXACTLY with a fresh
+        # sort_alerts_total scrape.  (This run is NOT clean by design —
+        # the fault leg injects a typed error the sentinel may burn on;
+        # the zero-false-alert guarantee is doctor_selftest's clean
+        # cell.)
+        az = json.loads(http_get(srv.metrics_port, "/alerts"))
+        if not az.get("enabled") or "series" not in az:
+            fails.append(f"/alerts incomplete: {sorted(az)}")
+        from mpitest_tpu.doctor import DOCTOR_RULES
+        bad_rules = [a["rule"] for a in az.get("alerts", [])
+                     if a.get("rule") not in DOCTOR_RULES]
+        if bad_rules:
+            fails.append(f"/alerts carries unregistered rules: "
+                         f"{bad_rules}")
+        fams_now = metrics_live.parse_prom_text(
+            http_get(srv.metrics_port, "/metrics").decode())
+        alerts_fam = fams_now.get("sort_alerts_total")
+        prom_alerts = sum(v for _n, _l, v in alerts_fam["samples"]) \
+            if alerts_fam else 0
+        if az.get("alerts_total", -1) != prom_alerts:
+            fails.append(f"/alerts total {az.get('alerts_total')} != "
+                         f"sort_alerts_total {prom_alerts}")
         with ServeClient(HOST, srv.port) as c:
             r3 = c.sort(rng.integers(-100, 100, size=256, dtype=np.int32))
             count("ok" if r3.ok else (r3.error or "?"))
@@ -327,8 +354,8 @@ def run(out: Path) -> int:
             log(f"[FAIL] {f}")
         return 1
     log("telemetry live selftest OK (trace ids, /metrics reconciled, "
-        "health/varz/flightrecorder/profile endpoints, flight dump "
-        "passes report --check, sampled stream schema-valid)")
+        "health/varz/flightrecorder/profile/alerts endpoints, flight "
+        "dump passes report --check, sampled stream schema-valid)")
     return 0
 
 

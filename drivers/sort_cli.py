@@ -151,6 +151,11 @@ def main(argv: list[str] | None = None) -> int:
             # out-of-core external sort (ISSUE 15): inputs above the
             # byte budget spill to runs and k-way merge back
             "SORT_MEM_BUDGET", "SORT_SPILL_DIR", "SORT_MERGE_FANIN",
+            # streaming sentinel (ISSUE 16): the knobs are serve-side
+            # but shared tooling (report --doctor thresholds) reads
+            # them, so garbage dies here too
+            "SORT_SENTINEL", "SORT_SENTINEL_WINDOW_S",
+            "SORT_ALERT_BURN_RATE",
         )
         # resolve the encode engine NOW: SORT_NATIVE_ENCODE=on with no
         # usable libencode.so is one clean [ERROR] line here, never a

@@ -106,6 +106,11 @@ def main(argv: list[str] | None = None) -> int:
             # stream to disk and ride the external sort
             "SORT_SERVE_SPILL", "SORT_SPILL_DIR", "SORT_MEM_BUDGET",
             "SORT_MERGE_FANIN",
+            # streaming sentinel (ISSUE 16): live anomaly alerting in
+            # the serve core — garbage thresholds die here, not on the
+            # first span close
+            "SORT_SENTINEL", "SORT_SENTINEL_WINDOW_S",
+            "SORT_ALERT_BURN_RATE",
         )
         from mpitest_tpu.utils import native_encode
 

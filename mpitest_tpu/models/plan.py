@@ -358,6 +358,18 @@ class SortPlan:
             # served by the spill tier, not rejected
             out["spilled"] = True
             out["spill_runs"] = _scalar(ext.actual.get("runs"))
+        # ISSUE 16: the doctor's plan-shaped verdicts (cap_thrash,
+        # window_misfit) ride the digest so a mis-planned run
+        # self-describes.  Lazy + best-effort: this module must stay
+        # stdlib-only at import (sortlint loads it standalone), and a
+        # digest never fails because diagnosis did.
+        try:
+            from mpitest_tpu.doctor import plan_findings
+            df = plan_findings(self.to_attrs())
+            if df:
+                out["doctor"] = df
+        except Exception:
+            pass
         return out
 
 

@@ -973,6 +973,18 @@ def main(argv: list[str] | None = None) -> int:
                          "given files plus the aggregate regret table. "
                          "Combine with --trace-id to scope; exit 1 when "
                          "no plan matches")
+    ap.add_argument("--doctor", nargs="?", const="", default=None,
+                    metavar="TRACE_ID|FILE",
+                    help="sort doctor (ISSUE 16): diagnose known "
+                         "pathologies over the trace — skew, cap "
+                         "thrash, compile storms, window misfit, "
+                         "spill-bound merges, verify overhead, breaker "
+                         "flap, SLO burn — each finding citing its "
+                         "evidence spans and the knob to turn.  The "
+                         "optional value is a trace id (one request) "
+                         "or a span file to read; exit 1 when the "
+                         "files carry no spans.  Rule vocabulary: "
+                         "mpitest_tpu/doctor.py DOCTOR_RULES")
     ap.add_argument("--prom", action="append", default=[],
                     metavar="FILE",
                     help="live mode: render a scraped /metrics snapshot "
@@ -1000,6 +1012,17 @@ def main(argv: list[str] | None = None) -> int:
             return 1
         else:
             explain_tid = args.explain
+    doctor_tid: str | None = None
+    if args.doctor is not None and args.doctor:
+        # same file-vs-trace-id disambiguation as --explain
+        if Path(args.doctor).exists():
+            files.append(args.doctor)
+        elif "/" in args.doctor or args.doctor.endswith(".jsonl"):
+            print(f"[ERROR] --doctor: {args.doctor}: no such file",
+                  file=sys.stderr)
+            return 1
+        else:
+            doctor_tid = args.doctor
     if not files and not args.prom:
         default = Path("bench/BASELINE_RESULTS.jsonl")
         if default.exists():
@@ -1025,6 +1048,30 @@ def main(argv: list[str] | None = None) -> int:
                   "predates plan provenance)", file=sys.stderr)
             return 1
         print(view)
+        return 0
+
+    if args.doctor is not None:
+        tid = doctor_tid or args.trace_id
+        span_rows = [r for r in rows if r.get("kind") == "span"]
+        if tid:
+            span_rows = [r for r in span_rows
+                         if (r.get("attrs") or {}).get(
+                             span_schema.TRACE_ID_ATTR) == tid]
+        if not span_rows:
+            where = f" carrying trace_id {tid!r}" if tid else ""
+            print(f"[ERROR] --doctor: no spans{where} across "
+                  f"{len(files)} file(s)", file=sys.stderr)
+            return 1
+        # lazy: the doctor is import-light but the timeline fold pulls
+        # the span layer; neither belongs on the other report paths
+        from mpitest_tpu import doctor as doctor_mod
+        from mpitest_tpu.utils import timeline
+        ev = doctor_mod.evidence_from_rows(
+            span_rows, timeline=timeline.build_timeline(span_rows))
+        ev["slo_target_pct"] = args.slo_target
+        findings = doctor_mod.diagnose(ev)
+        print(doctor_mod.render(findings))
+        # a diagnosis is a report, not a gate — findings exit 0
         return 0
 
     if args.trace_id is not None:

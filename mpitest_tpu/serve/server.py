@@ -201,6 +201,18 @@ class ServerCore:
         #: only the admission gauges are written directly.
         self.metrics = LiveMetrics()
         self.tracer.spans.observers.append(SpanMetricsBridge(self.metrics))
+        #: streaming SLO sentinel (ISSUE 16): a second span-close
+        #: observer, appended right after the bridge so its serve.alert
+        #: emissions are bridged into sort_alerts_total on the same
+        #: flush.  None when SORT_SENTINEL=off; /alerts snapshots it.
+        self.sentinel = None
+        if knobs.get("SORT_SENTINEL") != "off":
+            from mpitest_tpu.serve.sentinel import SortSentinel
+            self.sentinel = SortSentinel(
+                self.metrics, self.tracer.spans,
+                window_s=knobs.get("SORT_SENTINEL_WINDOW_S"),
+                burn_rate=knobs.get("SORT_ALERT_BURN_RATE"))
+            self.tracer.spans.observers.append(self.sentinel)
         #: on-demand jax.profiler captures around dispatches (ISSUE 10).
         self.profiler = ProfileHook(self.tracer.spans)
         # gauge publication rides the admission lock (see

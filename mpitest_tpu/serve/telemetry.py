@@ -201,11 +201,13 @@ class _Handler(BaseHTTPRequestHandler):
                 self._flightrecorder(parse_qs(url.query))
             elif route == "/profile":
                 self._profile(parse_qs(url.query))
+            elif route == "/alerts":
+                self._alerts()
             else:
                 self._json(404, {"error": f"unknown path {route!r}",
                                  "routes": ["/metrics", "/healthz",
                                             "/varz", "/flightrecorder",
-                                            "/profile"]})
+                                            "/profile", "/alerts"]})
         except BrokenPipeError:
             pass
         except Exception as e:  # noqa: BLE001 — a scrape bug must not kill
@@ -275,6 +277,16 @@ class _Handler(BaseHTTPRequestHandler):
                          "err": core.requests_err},
             "uptime_s": round(time.time() - core.started, 3),
         })
+
+    def _alerts(self) -> None:
+        # streaming-sentinel snapshot (ISSUE 16): alert history + the
+        # rolling-window series state; a router balances on this
+        sentinel = getattr(self.server.core, "sentinel", None)
+        if sentinel is None:
+            self._json(200, {"enabled": False, "alerts": [],
+                             "alerts_total": 0})
+            return
+        self._json(200, sentinel.snapshot())
 
     def _flightrecorder(self, query: dict) -> None:
         rec = flight_recorder.get()

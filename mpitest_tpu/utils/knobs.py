@@ -704,6 +704,26 @@ register("SORT_SERVE_SPILL", "enum", "auto", "auto | off",
          "out-of-core spill tier instead of a typed 'bytes' rejection.",
          _enum("SORT_SERVE_SPILL", ("auto", "off")))
 
+# Streaming-sentinel knobs (ISSUE 16: serve/sentinel.py) — live anomaly
+# detection over the span stream; alerts ride registered serve.alert
+# spans into /alerts, sort_alerts_total and the flight recorder.
+
+register("SORT_SENTINEL", "enum", "on", "on | off",
+         "Streaming SLO sentinel in the serve core: rolling-window "
+         "burn-rate/drift/imbalance detection raising registered "
+         "serve.alert events ('off' detaches the observer entirely).",
+         _enum("SORT_SENTINEL", ("on", "off")))
+register("SORT_SENTINEL_WINDOW_S", "float", 60.0, "a finite number > 0",
+         "Rolling evaluation window of the sentinel's series (burn "
+         "rate, regrows, breaker trips) — also the per-rule alert "
+         "cooldown.",
+         _float_gt0("SORT_SENTINEL_WINDOW_S"))
+register("SORT_ALERT_BURN_RATE", "float", 2.0, "a finite number > 0",
+         "Error-budget burn-rate multiple (vs the 99.9% SLO allowance) "
+         "at which the sentinel raises deadline_burn; 2x that multiple "
+         "escalates to critical and dumps the flight recorder.",
+         _float_gt0("SORT_ALERT_BURN_RATE"))
+
 # Bench-driver knobs (bench.py).
 
 

@@ -125,6 +125,11 @@ METRICS: dict[str, tuple[str, str]] = {
         "counter", "On-demand jax.profiler captures taken."),
     "sort_flight_dumps_total": (
         "counter", "Flight-recorder artifacts dumped."),
+    # streaming sentinel (ISSUE 16): serve.alert spans bridged by rule
+    # + severity; rule names are the doctor.DOCTOR_RULES vocabulary
+    "sort_alerts_total": (
+        "counter", "Sentinel anomaly alerts raised (labels: rule, "
+                   "severity)."),
     # plan provenance (ISSUE 12): predicted-vs-actual regret per
     # decision, exported live so mis-sized caps / wasted restages /
     # wrong reroutes are visible in /metrics before they cost
@@ -521,6 +526,12 @@ class SpanMetricsBridge:
                 metrics.counter("sort_serve_watchdog_trips_total").inc(1)
             elif event == "drain_timeout":
                 metrics.counter("sort_serve_drain_timeout_total").inc(1)
+        elif name == "serve.alert":
+            # sentinel anomaly alerts (ISSUE 16) — rule names are the
+            # registered doctor.DOCTOR_RULES vocabulary (SL007)
+            metrics.counter("sort_alerts_total").inc(
+                1, rule=str(attrs.get("rule", "?")),
+                severity=str(attrs.get("severity", "?")))
         # serve.hedge is deliberately NOT bridged: the ResilientClient
         # increments sort_client_hedges_total directly at hedge-launch
         # (semantics: hedges FIRED), and a client wired with both a

@@ -87,6 +87,13 @@ SPAN_NAMES: dict[str, str] = {
                        "trail"),
     "serve.hedge": ("one client-side hedged request (winner=primary|"
                     "hedge, waited_ms) — the p99-tail second attempt"),
+    # streaming sentinel vocabulary (ISSUE 16): one point event per
+    # raised anomaly alert; rule names come from doctor.DOCTOR_RULES
+    # (sortlint SL007) and the bridge folds them into
+    # sort_alerts_total{rule,severity}
+    "serve.alert": ("one sentinel anomaly alert (rule, severity, "
+                    "value, threshold, window_s) — serve/sentinel.py "
+                    "rolling-window detection; /alerts lists them"),
     # plan provenance (ISSUE 12): one point event per finished sort (or
     # packed serve dispatch) carrying the full decision record —
     # decisions {algo, cap, restage, engine, passes, ladder, batch}
@@ -138,6 +145,10 @@ SERVE_PROFILE_SPAN = "serve.profile"
 SERVE_DEADLINE_SPAN = "serve.deadline"
 SERVE_WATCHDOG_SPAN = "serve.watchdog"
 SERVE_HEDGE_SPAN = "serve.hedge"
+
+#: Streaming-sentinel name (ISSUE 16): anomaly alerts over rolling
+#: windows; rule vocabulary lives in mpitest_tpu/doctor.py.
+SERVE_ALERT_SPAN = "serve.alert"
 
 #: Plan-provenance name (ISSUE 12): the decision record report.py
 #: --explain renders and the /varz decision snapshot aggregates.

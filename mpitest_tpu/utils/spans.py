@@ -389,6 +389,12 @@ class SpanLog:
         Timed spans become ``"ph": "X"`` complete events; point events
         become ``"ph": "i"`` instants.  Timestamps are microseconds on
         the same process-relative clock the spans were recorded on.
+
+        The host driver lane is tid 1; ``utils/timeline.py`` then adds
+        one stable tid per rank (estimated activity lanes from the
+        exchange byte accounting — ISSUE 16: overlapping passes render
+        side by side instead of flattening onto the host lane), a disk
+        track, and counter tracks for inflight bytes / cap regrowth.
         """
         events: list[dict] = [{
             "name": "process_name", "ph": "M", "pid": 1, "tid": 1,
@@ -409,6 +415,12 @@ class SpanLog:
                     "name": s.name, "ph": "i", "s": "t", "pid": 1,
                     "tid": 1, "ts": s.t0 * 1e6, "args": args,
                 })
+        try:
+            # lazy: timeline imports this module's interval helpers
+            from mpitest_tpu.utils import timeline
+            events.extend(timeline.chrome_events(list(self.spans)))
+        except Exception:
+            pass  # enrichment is best-effort; the host lane stands alone
         return {"traceEvents": events, "displayTimeUnit": "ms"}
 
     # -- aggregation (shared with the report CLI) ---------------------

@@ -143,6 +143,47 @@ def test_sl006_planner_policies_come_from_registry():
     assert lint_source(bad, "mpitest_tpu/models/planner.py") == []
 
 
+def test_sl007_doctor_rules_come_from_registry():
+    bad = "doctor.run_rule('warp_drive_misfire', ev)\n"
+    assert rules_of(lint_source(bad, "x.py")) == ["SL007"]
+    # alerts are policed on ANY receiver — the sentinel raises them
+    bad2 = "self._alert('made_up', 'warn', 'x', value=1.0, threshold=1)\n"
+    assert rules_of(lint_source(bad2, "x.py")) == ["SL007"]
+    # serve.alert emissions carry a rule label that must be registered
+    bad3 = "spans.record('serve.alert', 0.0, 0.0, rule='made_up')\n"
+    assert rules_of(lint_source(bad3, "x.py")) == ["SL007"]
+    # a computed name is allowed: run_rule/_alert raise KeyError on
+    # unregistered names at runtime — the call IS the registry check
+    nonlit = ("doctor.run_rule(name, ev)\n"
+              "self._alert(rule, sev, msg, value=v, threshold=t)\n"
+              "spans.record('serve.alert', 0.0, 0.0, rule=rule)\n")
+    assert lint_source(nonlit, "x.py") == []
+    good = ("doctor.run_rule('cap_thrash', ev)\n"
+            "self._alert('deadline_burn', 'critical', 'x', value=3.0, "
+            "threshold=2.0)\n"
+            "spans.record('serve.alert', 0.0, 0.0, rule='skew_imbalance')\n")
+    assert lint_source(good, "x.py") == []
+    # unrelated receivers never match the run_rule shape
+    unrelated = "router.run_rule('whatever', ev)\n"
+    assert lint_source(unrelated, "x.py") == []
+    # the registry module itself is exempt
+    assert lint_source(bad, "mpitest_tpu/doctor.py") == []
+
+
+def test_doctor_registry_vocabulary():
+    from mpitest_tpu import doctor as doctor_mod
+
+    assert all(doc for doc in doctor_mod.DOCTOR_RULES.values())
+    assert {"skew_imbalance", "cap_thrash", "compile_storm",
+            "window_misfit", "spill_bound",
+            "verify_overhead_regression", "breaker_flap",
+            "deadline_burn"} == set(doctor_mod.DOCTOR_RULES)
+    # every vocabulary key has a registered diagnosis function
+    assert set(doctor_mod.DOCTOR_RULES) == set(doctor_mod._RULES)
+    assert all(s in doctor_mod.SEVERITIES
+               for s in ("info", "warn", "critical"))
+
+
 def test_planner_registry_vocabulary():
     from mpitest_tpu.models import planner as planner_mod
 

@@ -60,6 +60,10 @@ LABEL_COLUMNS: tuple[tuple[str, str], ...] = (
     # ISSUE 14: the planner mode the row measured under — pinned "off"
     # on measured rows via setdefault; pre-r06 rounds render "-".
     ("planner", "planner"),
+    # ISSUE 16: the timeline fold's per-pass straggler factor
+    # (max/median rank bytes, the 8dev row's value when present) —
+    # rendered as a ratio string, no regression math, pre-r06 "-".
+    ("straggler", "straggler"),
 )
 
 _RUN_RE = re.compile(r"BENCH_r(\d+)\.json$")
@@ -119,6 +123,11 @@ def load_run(path: Path) -> dict[str, object]:
             elif name.endswith("_8dev"):
                 put("cap_saving_pct", obj.get("cap_saving_pct"))
                 put("plan_regret", obj.get("plan_regret"))
+                # ISSUE 16: the scale-out row is the one with a real
+                # exchange, so its straggler wins over the primary's
+                sf = obj.get("straggler_factor")
+                if isinstance(sf, (int, float)):
+                    labels["straggler"] = f"{sf:g}x"
             elif name.startswith("external_sort_"):
                 # ISSUE 15: the out-of-core row — never folded into
                 # the in-memory sort column
@@ -134,6 +143,11 @@ def load_run(path: Path) -> dict[str, object]:
                 # ISSUE 14: ditto the planner column
                 if isinstance(obj.get("planner"), str):
                     labels["planner"] = obj["planner"]
+                # ISSUE 16: primary-row straggler only when no 8dev
+                # row carried one (single-device runs usually don't)
+                sf = obj.get("straggler_factor")
+                if isinstance(sf, (int, float)):
+                    labels.setdefault("straggler", f"{sf:g}x")
     vals["_labels"] = labels  # type: ignore[assignment]
     # derived: end-to-end ratio when a round recorded both throughputs
     # but not the ratio itself (pre-ISSUE-6 rounds)

@@ -18,6 +18,16 @@ SL004     literal live-metric names (``<metrics>.counter/gauge/
           histogram("...")``) must come from the registered vocabulary
           in ``utils/metrics_live.py`` — same contract as SL003 for the
           /metrics exposition surface (ISSUE 10).
+SL005     literal plan decision names (``<plan>.decide/actual/
+          bump("...")``) must come from ``models/plan.py``
+          PLAN_DECISIONS (ISSUE 12 provenance vocabulary).
+SL006     literal planner policy names must come from
+          ``models/planner.py`` PLANNER_POLICIES — at lookups and at
+          recorded planner verdicts (ISSUE 14).
+SL007     literal pathology rule names (doctor ``run_rule``, sentinel
+          ``.alert/._alert``, ``serve.alert`` emissions' ``rule=``)
+          must come from ``mpitest_tpu/doctor.py`` DOCTOR_RULES
+          (ISSUE 16 diagnosis vocabulary).
 SL010     no ``lax.reduce`` — custom reduction computations are
           UNIMPLEMENTED under the SPMD partitioner (CHANGES.md, PR 3);
           use halving folds / jnp reductions.

@@ -446,6 +446,99 @@ register(Rule(
     _check_planner_policy))
 
 
+# ---------------------------------------------------------------- SL007
+
+def _load_doctor_schema() -> Any:
+    """mpitest_tpu/doctor.py by file path (stdlib-only at import by
+    design, like plan.py) — SL007 checks against the real
+    DOCTOR_RULES."""
+    import sys
+
+    path = REPO_ROOT / "mpitest_tpu" / "doctor.py"
+    spec = importlib.util.spec_from_file_location("_sortlint_doctor", path)
+    assert spec is not None and spec.loader is not None
+    mod = importlib.util.module_from_spec(spec)
+    # doctor.py declares dataclasses — register before exec, like the
+    # plan.py loader above
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_DOCTOR_MOD = _load_doctor_schema()
+
+#: The module that IS the rule registry — SL007 polices users.
+_DOCTOR_EXEMPT = ("mpitest_tpu/doctor.py",)
+
+#: Receiver names that denote the doctor module.
+_DOCTOR_BASES = ("doctor", "doctor_mod", "sort_doctor")
+
+
+def _check_doctor_rule(path: str, src: str,
+                       tree: ast.AST) -> list[Finding]:
+    """SL007: literal pathology rule names must come from the
+    registered ``DOCTOR_RULES`` vocabulary (mpitest_tpu/doctor.py) —
+    at doctor lookups (``doctor.run_rule("x", ...)``), at sentinel
+    alert raises (``<any>.alert("x", ...)`` / ``._alert``), and on the
+    ``rule=`` kwarg of a literal ``"serve.alert"`` span emission.  An
+    unregistered rule name would vanish from the /alerts surfaces, the
+    ``sort_alerts_total{rule}`` labels and the doctor-selftest's
+    pathology accounting."""
+    if _ends(path, *_DOCTOR_EXEMPT):
+        return []
+    out = []
+
+    def vet(node: ast.Call, name: str, what: str) -> None:
+        if name not in _DOCTOR_MOD.DOCTOR_RULES:
+            out.append(Finding(
+                "SL007", path, node.lineno,
+                f"{what} {name!r} is not registered in "
+                "mpitest_tpu/doctor.py DOCTOR_RULES; register it there "
+                "(/alerts, the sort_alerts_total rule labels and the "
+                "doctor selftest key on these names)"))
+
+    for node, _ in _walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not isinstance(f, ast.Attribute):
+            continue
+        base = f.value
+        base_name = base.id if isinstance(base, ast.Name) else \
+            base.attr if isinstance(base, ast.Attribute) else ""
+        first = node.args[0] if node.args else None
+        literal = (first.value if isinstance(first, ast.Constant)
+                   and isinstance(first.value, str) else None)
+        if f.attr == "run_rule" and base_name in _DOCTOR_BASES \
+                and literal is not None:
+            # non-literal names are fine HERE: run_rule raises KeyError
+            # on unregistered names at runtime (the SL006 pattern)
+            vet(node, literal, "doctor rule")
+        elif f.attr in ("alert", "_alert") and literal is not None:
+            # attribute-shaped like SL003's .span: any receiver — the
+            # sentinel is the producer today, but a rule name baked
+            # into ANY alert raise must be registered
+            vet(node, literal, "alert rule")
+        elif f.attr in ("record", "event", "emit") \
+                and literal == "serve.alert":
+            # the span-emission chokepoint: a literal rule= kwarg on a
+            # serve.alert emission is a rule name too (non-literal
+            # kwargs route through SortSentinel._alert, which vets at
+            # runtime)
+            for kw in node.keywords:
+                if kw.arg == "rule" and isinstance(kw.value, ast.Constant) \
+                        and isinstance(kw.value.value, str):
+                    vet(node, kw.value.value, "serve.alert rule")
+    return out
+
+
+register(Rule(
+    "SL007", "doctor-rule-registry",
+    "literal pathology rule names must come from mpitest_tpu/doctor.py "
+    "DOCTOR_RULES",
+    _check_doctor_rule))
+
+
 # ------------------------------------------------------- SL010 / SL011 / SL012
 
 def _check_lax_reduce(path: str, src: str, tree: ast.AST) -> list[Finding]:

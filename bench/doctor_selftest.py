@@ -6,7 +6,7 @@ registered-span streams through the real ``SpanLog`` API — the same
 spans the live producers emit) and asserts the doctor names it, and
 ONLY it, with evidence citations.  Cells:
 
-* one cell per ``DOCTOR_RULES`` pathology (8): the evidence fold +
+* one cell per ``DOCTOR_RULES`` pathology (9): the evidence fold +
   timeline + ``diagnose()`` over the planted trace yields exactly the
   planted rule, and the rendered finding cites its evidence spans;
 * a CLI drill: ``report.py --doctor <trace>`` renders the skew cell's
@@ -118,6 +118,17 @@ def plant_verify_overhead(log) -> None:
     log.record("phase:verify", 2.0, 1.0)
 
 
+def plant_local_sort_lax(log) -> None:
+    # sort dominates the phase wall AND the plan says the local sort
+    # lowered through generic lax.sort on a TPU backend (ISSUE 17)
+    log.record("phase:sort", 0.0, 2.0)
+    log.record("phase:decode", 2.0, 0.5)
+    log.record("sort.plan", 2.5, 0.0, algo="radix", decisions={
+        "engine": {"chosen": "xla",
+                   "actual": {"local_engine": "lax", "backend": "tpu",
+                              "fallbacks": 0}}})
+
+
 def plant_breaker_flap(log) -> None:
     log.record("serve.watchdog", 0.0, 0.0, event="trip", age_s=130.0)
     log.record("serve.watchdog", 1.0, 0.0, event="recovered")
@@ -141,6 +152,7 @@ PATHOLOGY_CELLS = (
     ("window_misfit", plant_window_misfit),
     ("spill_bound", plant_spill_bound),
     ("verify_overhead_regression", plant_verify_overhead),
+    ("local_sort_lax", plant_local_sort_lax),
     ("breaker_flap", plant_breaker_flap),
     ("deadline_burn", plant_deadline_burn),
 )

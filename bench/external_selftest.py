@@ -223,6 +223,13 @@ def row_main() -> int:
     """Emit the bench row: spill+merge throughput on a dataset 4x the
     forced budget, output verified in-process before the row prints."""
     from mpitest_tpu.store import external
+    from mpitest_tpu.utils import knobs
+
+    # ISSUE 17: the merge rounds' order computation is engine-knobbed
+    # (store/merge._order_for); the row says which engine ran so the
+    # trajectory column can attribute a throughput move to an engine
+    # flip.  Measured rows pin the host path.
+    os.environ.setdefault("SORT_LOCAL_ENGINE", "lax")
 
     rng = np.random.default_rng(17)
     x = rng.integers(-(2**31), 2**31 - 1, size=N_KEYS, dtype=np.int32)
@@ -247,6 +254,7 @@ def row_main() -> int:
         "runs": res.runs, "disk_bytes": res.disk_bytes,
         "merge_passes": res.merge_passes,
         "wall_s": round(dt, 4),
+        "local_engine": str(knobs.get("SORT_LOCAL_ENGINE")),
     }))
     return 0
 

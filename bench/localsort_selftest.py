@@ -1,0 +1,344 @@
+#!/usr/bin/env python3
+"""Fused local-sort gate: the `make localsort-selftest` matrix (ISSUE 17).
+
+Proves the third local-sort engine — the fused per-pass radix kernel,
+the device-side merge-order kernel, and the planner's key-width
+compaction — end to end on any image, TPU-free (the kernels run under
+the Pallas interpreter, the engine's only honest evidence until a real
+TPU session re-baselines):
+
+1. **kernel bit-identity** — ``ops/radix_pallas.fused_radix_sort``
+   matches the ``np.lexsort`` oracle word for word across every codec
+   dtype x {uniform, dup-skew, sorted, tiny (N < chunk), non-divisible
+   N} input class, full-width AND compacted plans.
+2. **api bit-identity** — ``sort()`` under ``SORT_LOCAL_ENGINE=
+   radix_pallas`` is byte-identical to the lax engine with the ladder
+   pinned off (``SORT_FALLBACK=0`` — a silent degrade would pass
+   vacuously), single-device and on the virtual 8-device mesh for both
+   algorithms.
+3. **launch accounting** — a fused sort issues exactly one
+   ``pallas_call`` per planned pass (the perf claim is fusion, so the
+   launch count IS the evidence), and a 20-bit-narrow int64 plan is
+   measurably SHORTER than the full-width plan (the compaction win,
+   CPU-scale wall clock reported with the no-TPU caveat, gated on pass
+   count — interpreter wall time is weather).
+4. **merge parity** — the external-sort dataset (the external-selftest
+   row's exact generator) spill+merges bit-identical under the device
+   merge-order kernel vs the host ``np.lexsort`` path, and the kernel
+   provably RAN (call-counted) — not silently capped out to the host.
+5. **planner compaction** — a narrow-range profile chooses the
+   ``radix_compact`` policy, its predicted pass count matches what the
+   distributed radix actually ran (regret 0 on an honest profile), and
+   a lying profile (planted wide) stamps nonzero "passes" regret.
+
+Every cell failure prints loudly and the process exits nonzero; the
+Makefile target then schema-checks the emitted trace
+(``report.py --check --require-registered-spans``).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "bench"))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("SORT_RETRY_BACKOFF", "0")
+# Fail-fast by default: every parity cell must exercise the engine it
+# names, never a silently degraded rung.  (The ladder's own evidence
+# lives in bench/fault_selftest.py's forced-local-engine section.)
+os.environ.setdefault("SORT_FALLBACK", "0")
+os.environ.setdefault("SORT_MAX_RETRIES", "0")
+
+from mpitest_tpu.utils.platform import ensure_virtual_cpu_devices  # noqa: E402
+
+ensure_virtual_cpu_devices(8)
+
+import numpy as np  # noqa: E402
+
+from mpitest_tpu.models.api import sort  # noqa: E402
+from mpitest_tpu.ops import radix_pallas as rp  # noqa: E402
+from mpitest_tpu.ops.keys import codec_for  # noqa: E402
+from mpitest_tpu.parallel.mesh import make_mesh  # noqa: E402
+from mpitest_tpu.utils import knobs  # noqa: E402
+from mpitest_tpu.utils.trace import Tracer  # noqa: E402
+
+#: Gitignored checkout-scoped staging for the merge-parity leg.
+SPILL_DIR = REPO / "bench" / ".spill-out" / "localsort"
+
+#: Every codec dtype (the record/external gates' same list).
+DTYPES = (np.int8, np.int16, np.int32, np.int64,
+          np.uint8, np.uint16, np.uint32, np.uint64,
+          np.float32, np.float64)
+
+FAIL = 0
+
+
+def check(name: str, ok: bool, detail: str = "") -> None:
+    global FAIL
+    if not ok:
+        FAIL += 1
+    print(f"  {'ok ' if ok else 'BAD'} {name:<52} {detail}", flush=True)
+
+
+def _gen(kind: str, n: int, dtype, rng) -> np.ndarray:
+    dt = np.dtype(dtype)
+    if dt.kind == "f":
+        x = rng.normal(size=n).astype(dt)
+        if kind == "dup":
+            x = np.round(x).astype(dt)
+    else:
+        info = np.iinfo(dt)
+        if kind == "dup":
+            x = rng.integers(0, 5, size=n).astype(dt)
+        else:
+            x = rng.integers(info.min, info.max, size=n,
+                             dtype=dt, endpoint=True)
+    if kind == "sorted":
+        x = np.sort(x)
+    return x
+
+
+def kernel_parity_leg() -> None:
+    """Cell grid 1: fused_radix_sort vs the np.lexsort oracle on the
+    raw word planes, every dtype x input class, full + compacted."""
+    print("kernel bit-identity: fused_radix_sort vs np.lexsort oracle")
+    rng = np.random.default_rng(170)
+    classes = (("uniform", 2048), ("dup", 2048), ("sorted", 2048),
+               ("tiny", 7), ("nondiv", 1537))
+    for dtype in DTYPES:
+        codec = codec_for(dtype)
+        for kind, n in classes:
+            x = _gen("dup" if kind == "dup" else
+                     "sorted" if kind == "sorted" else "uniform",
+                     n, dtype, rng)
+            if kind == "sorted":
+                x = np.sort(x)
+            words = codec.encode(x)
+            ref = np.lexsort(tuple(reversed(words)))
+            got = rp.fused_radix_sort(
+                tuple(np.asarray(w) for w in words), interpret=True)
+            ok = all(np.array_equal(np.asarray(g), w[ref])
+                     for g, w in zip(got, words))
+            check(f"kernel {np.dtype(dtype).name:<8} {kind}", ok,
+                  f"n={n} words={len(words)}")
+    # compacted plan: 20-bit-narrow values in a 2-word codec — the
+    # constant high word is skipped, the low word runs at its width
+    x = np.random.default_rng(171).integers(
+        0, 1 << 20, size=2048, dtype=np.int64)
+    codec = codec_for(np.int64)
+    words = codec.encode(x)
+    diffs = tuple(int(w.max()) - int(w.min()) for w in words)
+    ref = np.lexsort(tuple(reversed(words)))
+    got = rp.fused_radix_sort(tuple(np.asarray(w) for w in words),
+                              diffs=diffs, interpret=True)
+    ok = all(np.array_equal(np.asarray(g), w[ref])
+             for g, w in zip(got, words))
+    plan = rp.pass_plan(diffs, len(words))
+    full = rp.pass_plan(None, len(words))
+    check("kernel int64 20-bit compacted plan", ok and len(plan) < len(full),
+          f"passes={len(plan)} vs full={len(full)}")
+
+
+def api_parity_leg(mesh8) -> None:
+    """Cell grid 2: sort() byte-identity lax vs fused engine, ladder
+    pinned, single-device + mesh8 x both algorithms."""
+    print("api bit-identity: SORT_LOCAL_ENGINE=radix_pallas vs lax "
+          "(SORT_FALLBACK=0)")
+    rng = np.random.default_rng(172)
+    for dtype in (np.int32, np.int64, np.uint32, np.float32, np.float64):
+        x = _gen("uniform", 4096, dtype, rng)
+        with knobs.scoped_env(SORT_LOCAL_ENGINE="lax"):
+            a = sort(x)
+        t = Tracer()
+        with knobs.scoped_env(SORT_LOCAL_ENGINE="radix_pallas"):
+            b = sort(x, tracer=t)
+        eng = t.counters.get("local_engine")
+        check(f"api 1dev {np.dtype(dtype).name}",
+              a.tobytes() == b.tobytes()
+              and str(eng).startswith("radix_pallas")
+              and "local_engine_degraded" not in t.counters,
+              f"engine={eng}")
+    for algo in ("radix", "sample"):
+        for dtype in (np.int64, np.uint32, np.float32):
+            x = _gen("uniform", 4096, dtype, rng)
+            with knobs.scoped_env(SORT_LOCAL_ENGINE="lax"):
+                a = sort(x, algorithm=algo, mesh=mesh8)
+            t = Tracer()
+            with knobs.scoped_env(SORT_LOCAL_ENGINE="radix_pallas"):
+                b = sort(x, algorithm=algo, mesh=mesh8, tracer=t)
+            eng = t.counters.get("local_engine")
+            check(f"api mesh8 {algo} {np.dtype(dtype).name}",
+                  a.tobytes() == b.tobytes()
+                  and str(eng).startswith("radix_pallas")
+                  and "local_engine_degraded" not in t.counters,
+                  f"engine={eng}")
+    # N < P: 5 keys across 8 ranks — the fused engine must survive the
+    # empty-shard staging exactly like lax
+    tiny = np.array([3, -1, 7, 0, 3], dtype=np.int32)
+    with knobs.scoped_env(SORT_LOCAL_ENGINE="lax"):
+        a = sort(tiny, algorithm="radix", mesh=mesh8)
+    with knobs.scoped_env(SORT_LOCAL_ENGINE="radix_pallas"):
+        b = sort(tiny, algorithm="radix", mesh=mesh8)
+    check("api mesh8 radix N<P", a.tobytes() == b.tobytes()
+          and a.tobytes() == np.sort(tiny).tobytes(), "n=5 P=8")
+
+
+def launch_count_leg() -> None:
+    """Cell grid 3: one pallas_call per planned pass, and the narrow
+    plan is shorter AND faster at CPU scale (pass count is the gate;
+    wall clock is reported with the no-TPU caveat)."""
+    print("launch accounting: one pallas_call per pass + compaction win")
+    rng = np.random.default_rng(173)
+    codec = codec_for(np.int64)
+    narrow = rng.integers(0, 1 << 20, size=4096, dtype=np.int64)
+    wide = rng.integers(-(2**62), 2**62, size=4096, dtype=np.int64)
+
+    def run(x):
+        words = tuple(np.asarray(w) for w in codec.encode(x))
+        diffs = tuple(int(w.max()) - int(w.min()) for w in words)
+        plan = rp.pass_plan(diffs, len(words))
+        before = rp.pass_launches()
+        t0 = time.perf_counter()
+        out = rp.fused_radix_sort(words, diffs=diffs, interpret=True)
+        np.asarray(out[0])
+        dt = time.perf_counter() - t0
+        return plan, rp.pass_launches() - before, dt
+
+    plan_n, launches_n, dt_n = run(narrow)
+    plan_w, launches_w, dt_w = run(wide)
+    check("launches == planned passes (narrow)",
+          launches_n == len(plan_n), f"{launches_n} == {len(plan_n)}")
+    check("launches == planned passes (wide)",
+          launches_w == len(plan_w), f"{launches_w} == {len(plan_w)}")
+    check("narrow plan shorter than wide", len(plan_n) < len(plan_w),
+          f"{len(plan_n)} < {len(plan_w)} passes")
+    print(f"  -- interpret wall: narrow {dt_n:.3f}s vs wide {dt_w:.3f}s "
+          "(CPU interpreter evidence only; fused kernels have never "
+          "lowered on a real TPU — re-baseline there)")
+
+
+def merge_parity_leg() -> None:
+    """Cell grid 4: the external-selftest dataset spill+merged under
+    the device merge-order kernel vs the host lexsort — bit-identical,
+    and the kernel call-counted as actually having run."""
+    print("merge parity: external sort, device merge-order vs host lexsort")
+    from mpitest_tpu.store import external, merge
+
+    budget = 1 << 18
+    n_keys = budget  # int32 -> 4x budget, the external-selftest ratio
+    rng = np.random.default_rng(17)  # the external row's generator
+    x = rng.integers(-(2**31), 2**31 - 1, size=n_keys, dtype=np.int32)
+    ref = np.sort(x)
+
+    with knobs.scoped_env(SORT_LOCAL_ENGINE="lax"):
+        host = external.external_sort(x, budget=budget,
+                                      spill_dir=str(SPILL_DIR / "host"))
+    calls = {"n": 0}
+    orig = rp.merge_order
+
+    def counted(planes, interpret=False):
+        calls["n"] += 1
+        return orig(planes, interpret=interpret)
+
+    # merge._order_for resolves rp.merge_order at call time, so the
+    # module-attribute patch counts every device-ordered round
+    rp.merge_order = counted
+    try:
+        with knobs.scoped_env(SORT_LOCAL_ENGINE="radix_pallas_interpret"):
+            dev = external.external_sort(x, budget=budget,
+                                         spill_dir=str(SPILL_DIR / "dev"))
+    finally:
+        rp.merge_order = orig
+    check("device merge bit-identical to host",
+          host.keys.tobytes() == dev.keys.tobytes()
+          and host.keys.tobytes() == ref.tobytes(),
+          f"n={n_keys} runs={dev.runs}")
+    check("merge-order kernel actually ran", calls["n"] > 0,
+          f"calls={calls['n']}")
+    check("host path == np.sort", host.keys.tobytes() == ref.tobytes())
+
+
+def planner_leg(mesh8) -> None:
+    """Cell grid 5: narrow profile -> radix_compact policy, honest
+    prediction (passes regret 0), lying profile stamps regret."""
+    print("planner compaction: radix_compact policy + passes regret")
+    from mpitest_tpu.models import plan as plan_mod
+    from mpitest_tpu.models import planner
+
+    rng = np.random.default_rng(174)
+    narrow = rng.integers(0, 1 << 20, size=1 << 14, dtype=np.int64)
+
+    prof = plan_mod.profile_host_array(narrow)
+    choice = planner.choose(prof, "radix", verify_on=True)
+    check("narrow profile chooses radix_compact",
+          choice.policy == "radix_compact"
+          and choice.trigger == "range_narrow",
+          f"policy={choice.policy} width={prof.get('key_width')}")
+
+    with knobs.scoped_env(SORT_PLANNER="on",
+                          SORT_LOCAL_ENGINE="radix_pallas"):
+        t = Tracer()
+        out = sort(narrow, algorithm="radix", mesh=mesh8, tracer=t)
+    ok_sorted = out.tobytes() == np.sort(narrow).tobytes()
+    d = t.plan.decisions.get("passes")
+    honest = (d is not None and d.trigger == "planner"
+              and d.regret == 0.0
+              and int(d.predicted.get("passes", -1)) == int(d.chosen))
+    check("honest profile: predicted passes ran, regret 0",
+          ok_sorted and honest,
+          f"passes={None if d is None else d.chosen} "
+          f"regret={None if d is None else d.regret}")
+
+    # lying profile: the sampled min/max promise a narrow key but the
+    # data is full-width — the distributed radix runs MORE passes than
+    # the planner predicted and the "passes" decision prices the lie.
+    wide = rng.integers(-(2**62), 2**62, size=1 << 14, dtype=np.int64)
+    orig_prof = plan_mod.profile_host_array
+
+    def lying_profile(arr, *a, **kw):
+        out = dict(orig_prof(arr, *a, **kw))
+        out["key_width"] = 20  # the lie: real width is ~63 bits
+        return out
+
+    plan_mod.profile_host_array = lying_profile
+    try:
+        with knobs.scoped_env(SORT_PLANNER="on"):
+            t2 = Tracer()
+            out2 = sort(wide, algorithm="radix", mesh=mesh8, tracer=t2)
+    finally:
+        plan_mod.profile_host_array = orig_prof
+    d2 = t2.plan.decisions.get("passes")
+    check("lying profile stamps nonzero passes regret",
+          out2.tobytes() == np.sort(wide).tobytes()
+          and d2 is not None and (d2.regret or 0.0) > 0.0,
+          f"regret={None if d2 is None else d2.regret}")
+
+
+def main() -> int:
+    import shutil
+
+    if SPILL_DIR.exists():
+        shutil.rmtree(SPILL_DIR)
+    SPILL_DIR.mkdir(parents=True, exist_ok=True)
+    mesh8 = make_mesh(8)
+    try:
+        kernel_parity_leg()
+        api_parity_leg(mesh8)
+        launch_count_leg()
+        merge_parity_leg()
+        planner_leg(mesh8)
+    finally:
+        shutil.rmtree(SPILL_DIR, ignore_errors=True)
+    print(f"\nlocalsort-selftest: "
+          f"{'CLEAN' if FAIL == 0 else f'{FAIL} BAD cell(s)'}")
+    return 1 if FAIL else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -60,7 +60,7 @@ from mpitest_tpu.models.ingest import (
     stream_to_mesh,
     use_stream,
 )
-from mpitest_tpu.ops import bitonic, kernels
+from mpitest_tpu.ops import bitonic, kernels, radix_pallas
 from mpitest_tpu.ops.keys import KeyCodec, codec_for
 from mpitest_tpu.parallel.mesh import AXIS, key_sharding, make_mesh
 from mpitest_tpu.utils import io as kio
@@ -540,9 +540,12 @@ def _local_engine() -> str:
     """Local (single-device) sort engine: the Pallas bitonic kernel
     (``ops/bitonic.py``) on real TPU backends for large one-word keys —
     measured 2.0-4.2x ``lax.sort`` at 2^26 on v5e post-relayout (r5) —
-    ``lax.sort`` otherwise.  ``SORT_LOCAL_ENGINE={auto,bitonic,lax}``
-    overrides."""
-    return knobs.get("SORT_LOCAL_ENGINE")
+    ``lax.sort`` otherwise.  ``SORT_LOCAL_ENGINE={auto,bitonic,lax,
+    radix_pallas,radix_pallas_interpret}`` overrides; the fused radix
+    family (``ops/radix_pallas.py``) is never chosen by ``auto`` until
+    the first real-TPU re-baseline (the kernels have only ever run
+    under interpret)."""
+    return supervision.local_engine_knob()
 
 
 def _use_bitonic(engine: str, n_words: int, n: int) -> bool:
@@ -562,6 +565,35 @@ def _bitonic_impl() -> str:
     return "bitonic" if jax.default_backend() == "tpu" else "bitonic_interpret"
 
 
+def _use_fused(engine: str, n_words: int, n: int) -> bool:
+    """True when the fused radix family can take this dispatch: the
+    knob asked for it AND the key/size fit the kernel's VMEM-resident
+    envelope.  Never True for ``auto`` — the fused kernels have only
+    ever run under interpret, so auto stays bitonic-on-TPU until the
+    first real-TPU re-baseline."""
+    return (engine.startswith("radix_pallas")
+            and n_words <= radix_pallas.FUSED_MAX_WORDS
+            and n <= radix_pallas.FUSED_MAX_ELEMS)
+
+
+def _resolve_local_engine(engine: str, n_words: int, n: int) -> str:
+    """Concrete local-sort engine for one dispatch: the fused radix
+    family resolves to real Mosaic on TPU backends and the Pallas
+    interpreter elsewhere (and to ``lax`` when the dispatch falls
+    outside its envelope); the bitonic family keeps its PR 5 rules;
+    everything else is ``lax``."""
+    if engine.startswith("radix_pallas"):
+        if not _use_fused(engine, n_words, n):
+            return "lax"
+        if engine == "radix_pallas_interpret" or \
+                jax.default_backend() != "tpu":
+            return "radix_pallas_interpret"
+        return "radix_pallas"
+    if _use_bitonic(engine, n_words, n):
+        return _bitonic_impl()
+    return "lax"
+
+
 @lru_cache(maxsize=8)
 def _compile_local_device(dtype_name: str,
                           engine: str = "auto") -> Callable[..., Any]:
@@ -570,9 +602,8 @@ def _compile_local_device(dtype_name: str,
 
     def f(x):
         words = codec.encode_jax(x)
-        if _use_bitonic(engine, len(words), x.size):
-            return kernels.local_sort(words, engine=_bitonic_impl())
-        return kernels.local_sort(words)
+        eng = _resolve_local_engine(engine, len(words), x.size)
+        return kernels.local_sort(words, engine=eng)
 
     return jax.jit(f)
 
@@ -613,19 +644,28 @@ def _compile_encode_pad(dtype_name: str, total: int,
     return jax.jit(f, out_shardings=key_sharding(mesh))
 
 
-@lru_cache(maxsize=8)
+@lru_cache(maxsize=16)
 def _compile_local(n_words: int,
-                   engine: str = "auto") -> Callable[..., Any]:
+                   engine: str = "auto",
+                   widths: tuple[int, ...] | None = None,
+                   ) -> Callable[..., Any]:
     """The 1-device specialization: both distributed algorithms degenerate
     to the local kernel when the mesh has a single device (no exchange, no
     splitters, no digit passes) — one fused local sort (the Pallas
     bitonic engine for large 1-word keys on TPU, else ``lax.sort``).
     The reference run with ``-np 1`` still pays its full protocol; here
-    the program specializes to what the hardware actually needs."""
+    the program specializes to what the hardware actually needs.
+
+    ``widths`` (per-word significant-bit widths, msw first) compacts the
+    fused radix engine's pass plan for range-narrow inputs; quantizing
+    the host-measured diffs to bit widths keeps this cache's key
+    vocabulary small (<= 33 values per word)."""
     def f(*words):
-        if _use_bitonic(engine, len(words), words[0].size):
-            return kernels.local_sort(words, engine=_bitonic_impl())
-        return kernels.local_sort(words)
+        eng = _resolve_local_engine(engine, len(words), words[0].size)
+        diffs = None
+        if widths is not None and eng.startswith("radix_pallas"):
+            diffs = tuple((1 << w) - 1 for w in widths)
+        return kernels.local_sort(words, engine=eng, diffs=diffs)
 
     return jax.jit(f)
 
@@ -634,7 +674,8 @@ def _compile_local(n_words: int,
 def _compile_radix(mesh: Mesh, n_words: int, n: int, digit_bits: int,
                    cap: int, passes: int, pack: str, donate: bool = False,
                    fault_token: str = "",
-                   exchange_engine: str = "lax") -> Callable[..., Any]:
+                   exchange_engine: str = "lax",
+                   local_engine: str = "lax") -> Callable[..., Any]:
     # fault_token: unique per armed exchange fault (mpitest_tpu.faults) —
     # a poisoned trace gets its own cache entry and can never be served
     # to a clean dispatch.  "" = the shared clean compile.
@@ -643,7 +684,7 @@ def _compile_radix(mesh: Mesh, n_words: int, n: int, digit_bits: int,
     def f(*words):
         out, max_cnt = radix_sort.radix_sort_spmd(
             words, n_words, digit_bits, n_ranks, cap, passes, pack=pack,
-            exchange_engine=exchange_engine,
+            exchange_engine=exchange_engine, local_engine=local_engine,
         )
         return out, max_cnt
 
@@ -659,7 +700,8 @@ def _compile_radix(mesh: Mesh, n_words: int, n: int, digit_bits: int,
             # forces pack to the engine's impl via _engine_pack, but e.g.
             # radix_pass_states-style callers can pass pack="xla" with a
             # pallas engine, whose transport still runs pallas kernels).
-            check_vma=(pack == "xla" and exchange_engine == "lax"),
+            check_vma=(pack == "xla" and exchange_engine == "lax"
+                       and local_engine == "lax"),
         ),
         # Donation: the input word shards alias the output word shards
         # (same shape/dtype/sharding), so HBM holds ONE copy of the keys
@@ -1005,6 +1047,10 @@ def _finish_plan(tracer: Tracer, plan: "plan_mod.SortPlan | None") -> None:
             plan.actual("engine", local_engine=str(engine))
         else:
             plan.decide("engine", chosen=str(engine))
+        # backend rides the engine actual so the doctor's local-sort
+        # rule can tell "lax on TPU" (a knob away from the fused
+        # engine) from "lax on CPU" (nothing to suggest)
+        plan.actual("engine", backend=str(jax.default_backend()))
     fallbacks = (int(c.get("pair_residual_fallback", 0))
                  - int(getattr(plan, "fallbacks_base", 0)))
     if fallbacks > 0:
@@ -1270,6 +1316,13 @@ def _sort_impl(
     eng0 = _resolve_exchange_engine(exchange_engine)
     _eng = {"v": eng0}
     tracer.counters["exchange_engine"] = eng0
+    # ---- local-sort engine (ISSUE 17): same ONE-mutable-state shape.
+    # _leng holds the KNOB-level value ("radix_pallas" family / bitonic
+    # / auto / lax); each dispatch resolves it per key-width and size
+    # via _resolve_local_engine.  The ladder may degrade the fused
+    # family to lax without touching the exchange engine.
+    leng0 = _local_engine()
+    _leng = {"v": leng0}
 
     # ---- plan provenance (ISSUE 12): the run's decision record ------
     plan = tracer.plan if isinstance(tracer.plan, plan_mod.SortPlan) \
@@ -1338,6 +1391,18 @@ def _sort_impl(
                 plan.decide("algo", chosen=pchoice.algo,
                             trigger="planner")
                 algorithm = pchoice.algo
+            if (pchoice.policy == "radix_compact"
+                    and "passes" in pchoice.predicted):
+                # key-width compaction (ISSUE 17): the profile's min/max
+                # promise a narrow key, so pre-record the predicted pass
+                # count.  run_radix keeps this prediction when it plans
+                # for real — the "passes" regret then prices a lying
+                # profile (sampled min/max missed the range, more passes
+                # ran than the planner promised).
+                plan.decide("passes",
+                            chosen=int(pchoice.predicted["passes"]),
+                            trigger="planner",
+                            passes=int(pchoice.predicted["passes"]))
 
     def _check_result(res_v, fp_v) -> bool:
         """Run the on-device verifier on a result; True = verified.
@@ -1388,15 +1453,21 @@ def _sort_impl(
         # 1-device mesh with pre-staged words: one fused local sort of
         # the padded shard (pads replicate the max key, so they sort to
         # the tail past n_valid — same contract as the host local path).
+        # The streamed ingest already folded per-word diffs, so the
+        # fused radix engine gets its compacted pass plan for free.
+        s_widths = (tuple(int(d).bit_length() for d in staged.word_diffs)
+                    if leng0.startswith("radix_pallas")
+                    and staged.word_diffs is not None else None)
         with tracer.phase("sort"):
             out = _traced_call(
                 tracer, "local",
-                _compile_local(codec.n_words, _local_engine()), *staged.words)
+                _compile_local(codec.n_words, leng0, s_widths),
+                *staged.words)
         return _finish_local(DistributedSortResult(out, N, dtype),
                              staged.fingerprint if verify_on else None)
 
     if staged is None and n_ranks == 1 and algorithm in ("radix", "sample"):
-        engine = _local_engine()
+        engine = leng0
         if (codec.n_words == 2 and engine != "lax"
                 and N >= (1 << bitonic.MIN_SORT_LOG2)
                 and (engine == "bitonic" or jax.default_backend() == "tpu")):
@@ -1419,10 +1490,8 @@ def _sort_impl(
             out = _local_pair_sort(x, is_device, codec, dtype, mesh, tracer,
                                    words_np=pair_words)
             return _finish_local(DistributedSortResult(out, N, dtype), fp_in)
-        tracer.counters["local_engine"] = (
-            "bitonic" if _use_bitonic(engine, codec.n_words, N)
-            else "lax"
-        )
+        tracer.counters["local_engine"] = _resolve_local_engine(
+            engine, codec.n_words, N)
         if is_device and _f64_known_broken(_device_platform(x), dtype, codec):
             x, is_device = _f64_host_input(x, tracer), False
         fp_in = None
@@ -1433,7 +1502,7 @@ def _sort_impl(
                 with tracer.phase("sort"):
                     out = _traced_call(
                         tracer, "local_device",
-                        _compile_local_device(dtype.name, _local_engine()),
+                        _compile_local_device(dtype.name, engine),
                         x.reshape(-1))
             except jax.errors.JaxRuntimeError as e:
                 # float64 device-side encode needs a f64->u32 bitcast some
@@ -1480,10 +1549,16 @@ def _sort_impl(
                 tracer.count("planner_passthrough_miss", 1)
                 if plan is not None:
                     plan.actual("planner", misses=1)
+            # fused-engine pass compaction: the host words are in hand,
+            # so one cheap max/min pass quantizes the per-word spread
+            # into the compile key's width vocabulary.
+            l_widths = (tuple(int(d).bit_length()
+                              for d in _word_diffs(words_np))
+                        if engine.startswith("radix_pallas") else None)
             with tracer.phase("sort"):
                 out = _traced_call(tracer, "local",
-                                   _compile_local(codec.n_words,
-                                                  _local_engine()), *words)
+                                   _compile_local(codec.n_words, engine,
+                                                  l_widths), *words)
         return _finish_local(DistributedSortResult(out, N, dtype), fp_in)
 
     #: per-word max^min already known without touching the data again
@@ -1769,9 +1844,22 @@ def _sort_impl(
         eng = _eng["v"]
         eff_pack, eff_align = _engine_pack(pack_impl, eng)
         tracer.counters["exchange_engine"] = eng
+        # Local engine inside the radix shards: only the fused family
+        # applies (the first pass's stable digit sort is a counting
+        # sort the fused kernel replaces 1:1); bitonic has no slot in
+        # the digit passes, so everything else stays lax.
+        leng = _resolve_local_engine(_leng["v"], codec.n_words, n)
+        radix_leng = leng if leng.startswith("radix_pallas") else "lax"
+        tracer.counters["local_engine"] = radix_leng
         if plan is not None:
-            plan.decide("passes", chosen=passes, passes=passes,
-                        digit_bits=db)
+            # keep a planner-predicted pass count (radix_compact) as
+            # the prediction this decision is scored against; the
+            # chosen/ran side comes from the real plan below.
+            d_passes = plan.decisions.get("passes")
+            keep = (d_passes is not None
+                    and "passes" in d_passes.predicted)
+            plan.decide("passes", chosen=passes, digit_bits=db,
+                        **({} if keep else {"passes": passes}))
         if negotiate and passes > 0:
             cnts = _negotiate("radix", db)
             need = _round_cap(int(cnts.max()), eff_align)
@@ -1792,7 +1880,8 @@ def _sort_impl(
         def attempt(c: int):
             fn = _compile_radix(mesh, codec.n_words, n, db, c, passes,
                                 eff_pack, donate, sup.arm_exchange(),
-                                exchange_engine=eng)
+                                exchange_engine=eng,
+                                local_engine=radix_leng)
             with tracer.phase("sort"):
                 out, max_cnt = sup.dispatch(
                     "radix_spmd", fn, live_words, on_retry=mark_dead,
@@ -1885,9 +1974,7 @@ def _sort_impl(
         elif plan is not None:
             plan.decide("cap", chosen=cap_start, trigger="off",
                         cap=cap_start, fair=fair)
-        spmd_engine = (_bitonic_impl() if _use_bitonic(_local_engine(),
-                                                       codec.n_words, n)
-                       else "lax")
+        spmd_engine = _resolve_local_engine(_leng["v"], codec.n_words, n)
         tracer.counters["local_engine"] = spmd_engine
 
         last_need = {"v": None}
@@ -1975,14 +2062,25 @@ def _sort_impl(
     # failure or repeated verification failure moves down.  The ladder
     # ends in a VERIFIED result or a typed error — never a silent wrong
     # answer.
-    rungs: list[tuple[str, str]] = [(algorithm, eng0)]
+    fused_local = leng0.startswith("radix_pallas")
+    #: lower-rung local engine: the fused family degrades to lax with
+    #: the rest of the rung; the bitonic/auto/lax values ride every
+    #: rung unchanged (their fallback story predates this ladder).
+    lower_leng = "lax" if fused_local else leng0
+    rungs: list[tuple[str, str, str]] = [(algorithm, eng0, leng0)]
     if supervision.fallback_enabled():
+        if fused_local:
+            # the LOCAL engine rung (ISSUE 17): a broken fused radix
+            # kernel must not cost the exchange engine or the
+            # algorithm — re-run the same rung on lax local sorts
+            rungs.append((algorithm, eng0, "lax"))
         if eng0 != "lax":
             # the engine rung: a broken pallas kernel must not cost the
             # requested ALGORITHM — re-run it on the XLA collective
-            rungs.append((algorithm, "lax"))
-        rungs.append(("sample" if algorithm == "radix" else "radix", "lax"))
-        rungs.append(("host", "lax"))
+            rungs.append((algorithm, "lax", lower_leng))
+        rungs.append(("sample" if algorithm == "radix" else "radix",
+                      "lax", lower_leng))
+        rungs.append(("host", "lax", "lax"))
     if plan is not None:
         plan.decide("ladder", chosen=rungs[0][0])
 
@@ -2019,7 +2117,23 @@ def _sort_impl(
             if plan is not None:
                 plan.actual("planner", misses=1)
 
-    for level, rung_eng in (() if res is not None else rungs):
+    for level, rung_eng, rung_leng in (() if res is not None else rungs):
+        if rung_leng != _leng["v"]:
+            tracer.verbose(
+                f"degrading local-sort engine {_leng['v']} -> {rung_leng}")
+            tracer.count("local_engine_degraded", 1)
+            _leng["v"] = rung_leng
+            if plan is not None:
+                # the engine decision keeps its chosen (the pack that
+                # runs); the degrade stamps its trigger, and the regret
+                # rule prices the descent exactly like exchange_engine
+                eng_d = plan.decisions.get("engine")
+                plan.decide(
+                    "engine",
+                    chosen=(eng_d.chosen if eng_d is not None
+                            else rung_leng),
+                    trigger=("pallas_fault" if last_fail == "dispatch"
+                             else "verify_failure"))
         if rung_eng != _eng["v"]:
             tracer.verbose(
                 f"degrading exchange engine {_eng['v']} -> {rung_eng}")

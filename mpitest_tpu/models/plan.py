@@ -55,7 +55,11 @@ PLAN_DECISIONS: dict[str, str] = {
     "restage": ("skew-aware re-stage verdict + trigger (probe/overflow); "
                 "predicted vs post-restage peer ratio"),
     "engine": ("exchange-pack and local-sort engine selection "
-               "(xla/pallas pack, lax/bitonic local)"),
+               "(xla/pallas pack, lax/bitonic/radix_pallas local); a "
+               "local-engine degrade (trigger=pallas_fault for "
+               "dispatch faults, verify_failure for failed "
+               "verification) is this decision's regret, beside the "
+               "pair-residual fallbacks"),
     "exchange_engine": ("inter-device exchange engine (ISSUE 13): "
                         "lax collective vs pallas remote-DMA + fused "
                         "pass; a degrade to lax (trigger=pallas_fault "
@@ -85,7 +89,8 @@ PLAN_DECISIONS: dict[str, str] = {
 #: Registered input-distribution profile fields (the probe-riding
 #: profiler's vocabulary — recorded on the plan and the sort.plan span).
 PLAN_PROFILE_FIELDS: tuple[str, ...] = (
-    "sortedness", "run_len", "dup_ratio", "bin_entropy", "skew_factor")
+    "sortedness", "run_len", "dup_ratio", "bin_entropy", "skew_factor",
+    "key_width")
 
 
 def relative_regret(predicted: float, actual: float) -> float:
@@ -269,8 +274,13 @@ class SortPlan:
                      if pred is not None and "waste" in a else 0.0)
             return waste + extra
         if d.name == "engine":
-            # an engine whose residual fallback ran paid both engines
-            return float(a.get("fallbacks", 0) or 0)
+            # an engine whose residual fallback ran paid both engines;
+            # a local-engine ladder degrade (fused radix -> lax, same
+            # trigger classes as exchange_engine) paid every dispatch
+            # up to the switch on top
+            return (float(a.get("fallbacks", 0) or 0)
+                    + (1.0 if d.trigger in ("pallas_fault",
+                                            "verify_failure") else 0.0))
         if d.name == "planner":
             # the planner's own cost: each passthrough miss paid one
             # verify dispatch that proved nothing (the strided profile
@@ -434,11 +444,21 @@ def profile_host_array(x: Any, n_profile_sample: int = PROFILE_SAMPLE,
     else:
         ss = np.sort(samp)
         dup = float(np.sum(ss[:-1] == ss[1:])) / (s - 1)
-    return {
+    out = {
         "sortedness": round(nondec, 4),
         "run_len": round(s / (descents + 1), 2),
         "dup_ratio": round(dup, 4),
     }
+    if np.issubdtype(samp.dtype, np.integer):
+        # significant-bit width of the SAMPLED value range (ISSUE 17) —
+        # the radix_compact policy's trigger.  A strided sample can
+        # miss the true extremes, so this may under-read: the planner's
+        # predicted pass count is scored against the pass count the
+        # full-range diff planner actually runs ("passes" regret = the
+        # lying-profile cost).
+        spread = int(samp.max()) - int(samp.min())
+        out["key_width"] = int(spread).bit_length()
+    return out
 
 
 def profile_from_counts(cnts: Any, fair: int) -> dict[str, float]:

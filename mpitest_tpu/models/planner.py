@@ -83,6 +83,13 @@ PLANNER_POLICIES: dict[str, str] = {
                      "width already cuts the radix pass count — route "
                      "to radix up front (the scored twin of the "
                      "reactive skew_sniff)"),
+    "radix_compact": ("range-narrow input (ISSUE 17): the profile's "
+                      "sampled min/max promise few significant key "
+                      "bits, so the radix route with its diff-driven "
+                      "pass planner (and the fused local engine's "
+                      "compacted pass plan) sorts in fewer, narrower "
+                      "passes than any comparison path — extends "
+                      "radix_narrow from dup-heavy to range-narrow"),
     "cap_margin": ("sample-negotiation margin sized from the observed "
                    "estimate-error quantiles in the flight ring "
                    "instead of the fixed x1.25 constant — the recorded "
@@ -157,6 +164,17 @@ def hysteresis() -> float:
 SORTED_SORTEDNESS = 1.0      # every sampled pair non-decreasing
 NEAR_SORTED_SORTEDNESS = 0.9
 DUP_RATIO_HEAVY = 0.25
+#: Max sampled key width (significant bits of max-min) that counts as
+#: range-narrow: 20 bits in an int64 is the canonical ISSUE 17 case —
+#: 3 radix passes instead of 8.  Mirrors the digit math: width/8 passes.
+NARROW_KEY_WIDTH_BITS = 20
+#: Digit widths the pass prediction considers — the radix default and
+#: the wide digit models/api.py's _auto_digit_bits switches to when it
+#: cuts the pass count; the prediction mirrors that rule (min passes
+#: over both widths) so an honest profile predicts the pass count that
+#: actually runs.
+NARROW_DIGIT_BITS = 8
+NARROW_WIDE_DIGIT_BITS = 16
 
 
 @dataclass
@@ -184,7 +202,10 @@ def choose(profile: dict, requested: str,
     Ordering: fully-sorted first (the passthrough beats everything and
     needs the verifier as its proof), then duplicate-heavy (a near-
     sorted but dup-heavy input would degenerate sample splitters — the
-    radix route wins even when sortedness is high), then near-sorted.
+    radix route wins even when sortedness is high), then near-sorted,
+    then range-narrow (a near-sorted narrow input still wants the
+    single-exchange sample path; compaction only pays on inputs the
+    multi-pass radix would run anyway).
     """
     sortedness = profile.get("sortedness")
     dup = profile.get("dup_ratio", 0.0)
@@ -205,6 +226,19 @@ def choose(profile: dict, requested: str,
             "merge_sample", "near_sorted",
             algo=None if requested == "sample" else "sample",
             predicted={"sortedness": sortedness})
+    width = profile.get("key_width")
+    if width is not None and 0 < int(width) <= NARROW_KEY_WIDTH_BITS:
+        # key-width compaction (ISSUE 17): predicted passes are what
+        # the diff planner will run IF the sampled range held; the
+        # "passes" plan decision scores that promise against the pass
+        # count actually dispatched (lying-profile regret)
+        w = int(width)
+        passes = min(-(-w // NARROW_DIGIT_BITS),
+                     -(-w // NARROW_WIDE_DIGIT_BITS))
+        return PolicyChoice(
+            "radix_compact", "range_narrow",
+            algo=None if requested == "radix" else "radix",
+            predicted={"key_width": w, "passes": passes})
     return PolicyChoice("static", "uniform")
 
 

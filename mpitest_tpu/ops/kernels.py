@@ -17,7 +17,8 @@ from jax import lax
 Words = tuple[jax.Array, ...]
 
 
-def local_sort(words: Words, engine: str = "lax") -> Words:
+def local_sort(words: Words, engine: str = "lax",
+               diffs: tuple[int, ...] | None = None) -> Words:
     """Lexicographic stable sort of multi-word keys (msw first).
 
     ``lax.sort`` with ``num_keys=len(words)`` compares word tuples
@@ -37,11 +38,26 @@ def local_sort(words: Words, engine: str = "lax") -> Words:
     kernels (see tests/test_aot_topology.py).  Wider keys always use
     ``lax.sort``.
 
+    ``engine="radix_pallas"`` routes any key width up to
+    ``radix_pallas.FUSED_MAX_WORDS`` through the fused per-pass radix
+    kernel (one ``pallas_call`` per pass — no sort/searchsorted/gather
+    chain); ``"radix_pallas_interpret"`` is its interpreter twin.
+    ``diffs`` (msw-first per-word value spreads, host-static) lets the
+    fused engine compact the pass plan for range-narrow keys; it is
+    ignored by every other engine.  Bit-identity with the lax form is
+    exact: each fused pass is a stable counting sort.
+
     Stability note: ``words`` is always the FULL key (no payload
     operands), so stability is unobservable in the output — equal key
     tuples are indistinguishable — and the unstable bitonic engines are
     exact drop-ins for the stable ``lax.sort`` form.
     """
+    if engine.startswith("radix_pallas"):
+        from mpitest_tpu.ops import radix_pallas  # local import: optional path
+
+        return radix_pallas.fused_radix_sort(
+            words, diffs=diffs,
+            interpret=engine == "radix_pallas_interpret")
     if engine.startswith("bitonic") and len(words) == 1:
         from mpitest_tpu.ops import bitonic  # local import: optional path
 

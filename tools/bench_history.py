@@ -57,6 +57,10 @@ COLUMNS: tuple[tuple[str, str, str, bool], ...] = (
 #: jump that coincides with an engine flip is attribution, not noise).
 LABEL_COLUMNS: tuple[tuple[str, str], ...] = (
     ("exchange_engine", "engine"),
+    # ISSUE 17: the local-sort engine the row measured under (lax /
+    # bitonic family / radix_pallas family) — pinned on measured rows
+    # via setdefault; pre-r06 rounds render "-".
+    ("local_engine", "local"),
     # ISSUE 14: the planner mode the row measured under — pinned "off"
     # on measured rows via setdefault; pre-r06 rounds render "-".
     ("planner", "planner"),
@@ -143,6 +147,9 @@ def load_run(path: Path) -> dict[str, object]:
                 # ISSUE 14: ditto the planner column
                 if isinstance(obj.get("planner"), str):
                     labels["planner"] = obj["planner"]
+                # ISSUE 17: ditto the local-sort engine column
+                if isinstance(obj.get("local_engine"), str):
+                    labels["local_engine"] = obj["local_engine"]
                 # ISSUE 16: primary-row straggler only when no 8dev
                 # row carried one (single-device runs usually don't)
                 sf = obj.get("straggler_factor")

@@ -77,6 +77,15 @@
 #                  spawned server serves a payload_bytes request and
 #                  an over-admission request (via the spill tier) each
 #                  bit-identical to the solo in-memory oracle.
+#   make durability-selftest — the crash-durability gate (ISSUE 18):
+#                  a real spawned server is SIGKILLed mid-external-sort
+#                  (merge wedged by an armed stall, every spill run
+#                  already committed to the dataset's journaled .mfst
+#                  manifest); a restarted server retrying the same
+#                  dataset_id must resume at the merge phase — reply
+#                  bit-identical, plan digest resumed:true, ZERO
+#                  external.run spans in the restart's trace, and the
+#                  manifest retired afterwards.
 #   make localsort-selftest — the fused local-engine gate (ISSUE 17):
 #                  interpret-mode bit-identity vs the lax engine across
 #                  every codec dtype x input class (kernel + api level,
@@ -111,7 +120,7 @@ PYTHON ?= python3
 .PHONY: test native native-encode chip-test telemetry-selftest \
     ingest-selftest fault-selftest multichip-selftest serve-selftest \
     chaos-serve-selftest planner-selftest external-selftest \
-    doctor-selftest localsort-selftest lint \
+    durability-selftest doctor-selftest localsort-selftest lint \
     cwarn-check typecheck tidy-check knob-docs sanitize-selftest \
     bench-history clean
 
@@ -255,6 +264,17 @@ external-selftest:
 	    $(PYTHON) -u bench/external_selftest.py
 	$(PYTHON) -m mpitest_tpu.report --check --require-registered-spans \
 	    $(EXTERNAL_TMP)/trace.jsonl
+
+# The crash-durability gate (ISSUE 18) — see bench/durability_selftest.py.
+# SIGKILL a real server mid-external-sort, restart, retry the same
+# dataset_id: the journaled manifest must turn the crash into a
+# checkpoint (resume at the merge phase, bit-identical reply, zero
+# external.run spans on the restart, manifest retired).
+DURABILITY_TMP := /tmp/mpitest_durability_selftest
+durability-selftest:
+	rm -rf $(DURABILITY_TMP) && mkdir -p $(DURABILITY_TMP)
+	JAX_PLATFORMS=cpu \
+	    $(PYTHON) -u bench/durability_selftest.py --out $(DURABILITY_TMP)
 
 # The fused local-sort gate (ISSUE 17) — see bench/localsort_selftest.py.
 # The third local engine (fused per-pass radix kernel + device-side

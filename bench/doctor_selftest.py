@@ -129,6 +129,15 @@ def plant_local_sort_lax(log) -> None:
                               "fallbacks": 0}}})
 
 
+def plant_spill_churn(log) -> None:
+    # one integrity recovery + one crash resume in the same trace —
+    # the spill volume itself becomes the suspect (ISSUE 18)
+    log.record("external.recover", 0.0, 0.0, reason="fingerprint",
+               bad_runs=1, attempt=1)
+    log.record("external.resume", 1.0, 0.0, dataset="ds1", committed=4,
+               valid=4, skipped_lines=0)
+
+
 def plant_breaker_flap(log) -> None:
     log.record("serve.watchdog", 0.0, 0.0, event="trip", age_s=130.0)
     log.record("serve.watchdog", 1.0, 0.0, event="recovered")
@@ -153,6 +162,7 @@ PATHOLOGY_CELLS = (
     ("spill_bound", plant_spill_bound),
     ("verify_overhead_regression", plant_verify_overhead),
     ("local_sort_lax", plant_local_sort_lax),
+    ("spill_churn", plant_spill_churn),
     ("breaker_flap", plant_breaker_flap),
     ("deadline_burn", plant_deadline_burn),
 )

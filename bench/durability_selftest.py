@@ -1,0 +1,216 @@
+#!/usr/bin/env python3
+"""Kill-resume drill: the `make durability-selftest` gate (ISSUE 18).
+
+SIGKILLs a REAL spawned ``sort_server`` mid-external-sort and proves
+the journaled spill manifest turns the crash into a checkpoint:
+
+1. server 1 runs with an armed ``merge_stall`` fault (30 s): an
+   over-``SORT_SERVE_MAX_BYTES`` request streams to the spill tier,
+   every partition run is spilled AND committed to the dataset's
+   ``.mfst`` journal, then the merge phase wedges on the stall;
+2. the parent watches the journal until ALL expected run lines are
+   durable, then ``SIGKILL -9``s the server — no drain, no atexit,
+   the genuine crash shape;
+3. server 2 restarts over the same ``SORT_SPILL_DIR`` (no faults) and
+   the client RETRIES the same request with the same ``dataset_id``:
+   the reply must be bit-identical to ``np.sort`` of the input, its
+   plan digest must carry ``resumed: true``, and server 2's trace must
+   contain ZERO ``external.run`` spans (the sort phase was skipped
+   outright) and at least one ``external.resume`` span;
+4. the retired manifest must be gone afterwards — a served dataset
+   leaves no journal behind.
+
+Runs TPU-free (plain 1-device CPU backend; the crash lives in the
+process lifecycle and the spill directory, not in the device math).
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "bench"))
+
+from serve_load import HOST, Server, log                     # noqa: E402
+
+from mpitest_tpu.serve.client import ServeClient             # noqa: E402
+from mpitest_tpu.store.external import spill_chunk_elems     # noqa: E402
+
+#: Stable client-chosen dataset id — reusing it on the retry is what
+#: keys the resume.
+DATASET = "drill1"
+
+#: Keys in the request: 800 kB of int32, far over the 64 kB admission
+#: budget below, so the request routes to the spill tier.
+N = 200_000
+
+#: External-sort memory budget: 13 spill runs for N int32 keys — under
+#: the default merge fan-in (16), so the merge is a single pass and the
+#: armed stall wedges it with every run already committed.
+BUDGET = 1 << 18
+
+#: The armed merge stall (ms): long enough for the parent to observe
+#: the fully-committed journal and deliver the SIGKILL.
+STALL_MS = 30_000
+
+results: list[tuple[str, bool, str]] = []
+
+
+def cell(name: str, ok: bool, detail: str) -> None:
+    results.append((name, ok, detail))
+    print(f"  {'ok ' if ok else 'BAD'} {name:<38} {detail}", flush=True)
+
+
+def journal_run_lines(mpath: Path) -> int:
+    """Committed ``run`` lines in the manifest journal (the torn tail a
+    concurrent append may leave parses as garbage and is skipped, same
+    as the loader's contract)."""
+    try:
+        raw = mpath.read_bytes()
+    except OSError:
+        return 0
+    n = 0
+    for ln in raw.split(b"\n"):
+        try:
+            row = json.loads(ln)
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            continue
+        if isinstance(row, dict) and row.get("kind") == "run":
+            n += 1
+    return n
+
+
+def span_counts(trace: Path) -> dict[str, int]:
+    counts: dict[str, int] = {}
+    try:
+        lines = trace.read_text().splitlines()
+    except OSError:
+        return counts
+    for ln in lines:
+        try:
+            row = json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+        name = row.get("name")
+        if isinstance(name, str):
+            counts[name] = counts.get(name, 0) + 1
+    return counts
+
+
+def main() -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default="/tmp/mpitest_durability_selftest")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    out = Path(args.out)
+    out.mkdir(parents=True, exist_ok=True)
+    spill = out / "spill"
+    shutil.rmtree(spill, ignore_errors=True)
+    spill.mkdir(parents=True)
+
+    rng = np.random.default_rng(args.seed)
+    x = rng.integers(-2**31, 2**31 - 1, size=N, dtype=np.int32)
+    ref = np.sort(x)
+    chunk = spill_chunk_elems(BUDGET, x.dtype, 0)
+    n_runs = -(-N // chunk)
+    mpath = spill / f"{DATASET}.mfst"
+    env_common = {
+        "SORT_SERVE_MAX_BYTES": str(64 * 1024),
+        "SORT_MEM_BUDGET": str(BUDGET),
+        "SORT_SPILL_DIR": str(spill),
+        "SORT_RESUME": "auto",
+        "SORT_SERVE_BATCH_WINDOW_MS": "0",
+    }
+
+    print(f"kill-resume drill: {N} int32 keys -> {n_runs} journaled "
+          f"runs, SIGKILL at the merge stall, restart, retry")
+
+    # ---- phase 1: the victim server, merge wedged ---------------
+    srv1 = Server(out, "durability1", {
+        **env_common,
+        "SORT_FAULTS": "merge_stall",
+        "SORT_FAULT_STALL_MS": str(STALL_MS),
+        # the stall is ARMED, not a pathology: keep the watchdog from
+        # tripping (and dumping flight artifacts) while it holds
+        "SORT_SERVE_DISPATCH_TIMEOUT_S": "120",
+    })
+    victim: dict = {}
+
+    def send_victim() -> None:
+        try:
+            with ServeClient(HOST, srv1.port, timeout=120) as c:
+                victim["reply"] = c.sort(x, dataset_id=DATASET)
+        except (OSError, ConnectionError) as e:
+            victim["exc"] = e
+
+    t = threading.Thread(target=send_victim, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 150.0
+    committed = 0
+    while time.monotonic() < deadline:
+        committed = journal_run_lines(mpath)
+        if committed >= n_runs:
+            break
+        if srv1.proc.poll() is not None:
+            break
+        time.sleep(0.1)
+    cell("all runs journaled before kill", committed >= n_runs,
+         f"{committed}/{n_runs} run lines in {mpath.name}")
+
+    # SIGKILL, not SIGTERM: no drain, no finally blocks, no atexit —
+    # the journal on disk is everything the restart gets
+    srv1.proc.kill()
+    srv1.proc.wait(timeout=30)
+    srv1._stderr_f.close()
+    t.join(timeout=30)
+    died = "exc" in victim or not victim.get("reply", None)
+    cell("victim request died with the server", died,
+         f"client saw {type(victim.get('exc')).__name__}"
+         if "exc" in victim else f"reply={victim.get('reply')!r}")
+    cell("journal survives the crash", mpath.exists(),
+         f"{mpath.name} present with {journal_run_lines(mpath)} runs")
+
+    # ---- phase 2: restart + retry = resume ----------------------
+    srv2 = Server(out, "durability2", env_common)
+    try:
+        with ServeClient(HOST, srv2.port, timeout=300) as c:
+            r = c.sort(x, dataset_id=DATASET)
+        ok_bits = bool(r.ok and np.array_equal(r.arr, ref))
+        cell("retried reply bit-identical", ok_bits,
+             "np.array_equal vs np.sort" if ok_bits
+             else f"ok={r.ok} error={getattr(r, 'error', None)}")
+        plan = r.plan or {}
+        cell("plan digest says resumed", plan.get("resumed") is True,
+             f"plan.resumed={plan.get('resumed')!r}")
+    finally:
+        rc = srv2.stop()
+    cell("restarted server drains clean", rc == 0, f"rc={rc}")
+
+    spans = span_counts(srv2.trace)
+    cell("sort phase skipped on resume",
+         spans.get("external.run", 0) == 0,
+         f"external.run spans={spans.get('external.run', 0)} "
+         f"(every chunk came from the journal)")
+    cell("manifest replayed", spans.get("external.resume", 0) >= 1,
+         f"external.resume spans={spans.get('external.resume', 0)}")
+    cell("manifest retired after success", not mpath.exists(),
+         f"{mpath.name} {'still present' if mpath.exists() else 'gone'}")
+
+    n_bad = sum(1 for _n, ok, _d in results if not ok)
+    print(f"\ndurability-selftest: {len(results) - n_bad}/"
+          f"{len(results)} cells clean ({n_bad} failing)")
+    return 1 if n_bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

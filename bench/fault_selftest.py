@@ -72,7 +72,18 @@ def main() -> int:
     #: shared /tmp path a concurrent checkout could interleave with.
     spill_dir = REPO / "bench" / ".spill-out" / "faultgrid"
 
-    print("fault grid: 11 sites x {radix, sample} — must recover verified")
+    #: sites living in the out-of-core store (ISSUE 15 + the ISSUE 18
+    #: disk-fault family): drilled through the external sort at a
+    #: forced tiny budget.  manifest_torn needs a dataset id (the
+    #: journal only exists for dataset-keyed sorts); spill_enospc is
+    #: the ONE site whose acceptable outcome is a typed capacity error
+    #: rather than recovery.
+    STORE_SITES = ("spill_corrupt", "merge_drop", "spill_torn_write",
+                   "spill_bitrot", "spill_enospc", "manifest_torn",
+                   "merge_stall")
+
+    print(f"fault grid: {len(faults.SITES)} sites x {{radix, sample}} "
+          "— must recover verified (or fail typed: spill_enospc)")
     for site in faults.SITES:
         for algo in ("radix", "sample"):
             env_extra = {}
@@ -80,23 +91,23 @@ def main() -> int:
                 # the poison hook lives in the streamed ingest pipeline
                 env_extra = {"SORT_INGEST": "stream",
                              "SORT_INGEST_CHUNK": "4096"}
+            elif site == "merge_stall":
+                env_extra = {"SORT_FAULT_STALL_MS": "10"}
             reg = faults.FaultRegistry(site, seed=7)
             faults.install(reg)
             tr = Tracer()
             try:
                 with knobs.scoped_env(**env_extra):
-                    if site in ("spill_corrupt", "merge_drop"):
-                        # these sites live in the out-of-core store
-                        # (ISSUE 15): drill them through the external
-                        # sort at a forced tiny budget — the blamed
-                        # run re-spills (or the merge re-runs) and the
-                        # result must still be bit-exact
+                    if site in STORE_SITES:
                         from mpitest_tpu.store import external
 
                         got = external.external_sort(
                             x, algorithm=algo, mesh=mesh, tracer=tr,
                             budget=1 << 17,
-                            spill_dir=str(spill_dir)).keys
+                            spill_dir=str(spill_dir),
+                            dataset=(f"grid_{site}_{algo}"
+                                     if site == "manifest_torn"
+                                     else None)).keys
                     else:
                         got = sort(x, algorithm=algo, mesh=mesh,
                                    tracer=tr)
@@ -105,14 +116,28 @@ def main() -> int:
                 detail = (f"faults={reg.injected} "
                           f"retries={int(tr.counters.get('sort_retries', 0) + tr.counters.get('exchange_retries', 0))} "
                           f"verify_failures={int(tr.counters.get('verify_failures', 0))}")
-                cell(f"{site} x {algo}", exact and fired,
-                     detail + ("" if exact else " WRONG RESULT")
-                     + ("" if fired else " FAULT NEVER FIRED"))
+                if site == "spill_enospc":
+                    cell(f"{site} x {algo}", False,
+                         "completed despite injected ENOSPC "
+                         "(typed SpillCapacityError expected)")
+                else:
+                    cell(f"{site} x {algo}", exact and fired,
+                         detail + ("" if exact else " WRONG RESULT")
+                         + ("" if fired else " FAULT NEVER FIRED"))
             except (SortIntegrityError, SortRetryExhausted) as e:
                 # loud, typed failure is an acceptable outcome — but for
                 # single transient faults the ladder should recover
                 cell(f"{site} x {algo}", False,
                      f"typed error on a transient fault: {type(e).__name__}")
+            except OSError as e:
+                from mpitest_tpu.store import external as _ext
+
+                ok = (site == "spill_enospc"
+                      and isinstance(e, _ext.SpillCapacityError))
+                cell(f"{site} x {algo}", ok,
+                     f"{type(e).__name__} "
+                     + ("(typed, loud, partials deleted)" if ok
+                        else "(unexpected OSError)"))
             finally:
                 faults.install(None)
 

@@ -106,6 +106,9 @@ def main(argv: list[str] | None = None) -> int:
             # stream to disk and ride the external sort
             "SORT_SERVE_SPILL", "SORT_SPILL_DIR", "SORT_MEM_BUDGET",
             "SORT_MERGE_FANIN",
+            # crash-durable spill tier (ISSUE 18): journaled manifests,
+            # kill-resume, the orphan GC sweep, the disk-fault drills
+            "SORT_RESUME", "SORT_SPILL_GC_AGE_S", "SORT_FAULT_ENOSPC_AT",
             # streaming sentinel (ISSUE 16): live anomaly alerting in
             # the serve core — garbage thresholds die here, not on the
             # first span close
@@ -126,6 +129,16 @@ def main(argv: list[str] | None = None) -> int:
 
     core = ServerCore()
     core.prewarm(log)
+    # Startup orphan GC (ISSUE 18): reclaim spill files no live
+    # manifest references — age-gated (SORT_SPILL_GC_AGE_S) so a
+    # concurrent sort's fresh files are never swept.  Journals that DO
+    # replay are left alone: they are exactly the resume signal.
+    if knobs.get("SORT_SERVE_SPILL") != "off":
+        from mpitest_tpu.store import external as _external
+
+        swept = _external.gc_spill_dir(tracer=core.tracer)
+        if swept:
+            log(f"spill GC: reclaimed {swept} orphaned file(s)")
     # dispatch watchdog (ISSUE 11): monitors the single dispatch
     # thread's heartbeat; a dispatch past SORT_SERVE_DISPATCH_TIMEOUT_S
     # trips the circuit breaker (healthz 503, fast typed rejections,
@@ -206,6 +219,20 @@ def main(argv: list[str] | None = None) -> int:
         log(f"drain TIMEOUT: {len(stuck)} request(s) still in flight "
             f"(trace_ids={stuck}); flight recorder dumped to "
             f"{path or '(nothing)'}")
+        # ISSUE 18: a dirty exit may strand journaled external sorts —
+        # name the datasets a restarted server can warm-resume (the
+        # manifests stay on disk; only a clean finish deletes them).
+        if knobs.get("SORT_SERVE_SPILL") != "off":
+            from mpitest_tpu.store import external as _external
+            from mpitest_tpu.store import manifest as _mfst
+
+            live = _mfst.live_manifests(
+                _external.resolve_spill_dir(None))
+            if live:
+                log("resumable spill datasets: "
+                    + ", ".join(m.dataset for m in live)
+                    + " (a restarted server re-enters them at the "
+                    "merge phase)")
     log(f"drained={'clean' if drained else 'TIMEOUT'} "
         f"served_ok={core.requests_ok} errors={core.requests_err} "
         f"rejected={core.admission.rejected} "

@@ -73,6 +73,10 @@ DOCTOR_RULES: dict[str, str] = {
         "the local sort dominates the critical path while the engine "
         "resolved to generic lax.sort on a TPU backend — the fused "
         "radix engine is one knob away",
+    "spill_churn":
+        "the external sort keeps re-spilling or crash-resuming — "
+        "repeated integrity recoveries / manifest replays in one "
+        "trace point at a failing spill volume",
 }
 
 # diagnosis thresholds — module constants so tests cite them and the
@@ -97,6 +101,10 @@ DEFAULT_SLO_TARGET_PCT = 99.9
 # path's dominant phase AND carry at least this fraction of the phase
 # wall before a lax-on-TPU local engine is worth a knob suggestion
 LOCAL_SORT_PHASE_GATE = 0.4
+# spill_churn (ISSUE 18): integrity recoveries + manifest resumes in
+# one trace before the spill volume itself is the suspect (one of
+# either is normal operation: a single blamed run, a single restart)
+SPILL_CHURN_GATE = 2
 
 
 @dataclass
@@ -403,6 +411,31 @@ def _r_local_sort_lax(ev: dict) -> Finding | None:
         direction="set radix_pallas (fused per-pass local radix "
                   "kernel; re-baseline on first TPU use)",
         value=round(frac, 4), threshold=LOCAL_SORT_PHASE_GATE)
+
+
+@_rule("spill_churn")
+def _r_spill_churn(ev: dict) -> Finding | None:
+    spans = ev.get("spans") or {}
+    recovers = int(spans.get("external.recover", 0))
+    resumes = int(spans.get("external.resume", 0))
+    churn = recovers + resumes
+    if churn < SPILL_CHURN_GATE:
+        return None
+    cites = []
+    if recovers:
+        cites.append(f"external.recover: {recovers} integrity "
+                     "recovery(ies) — runs re-spilled from source")
+    if resumes:
+        cites.append(f"external.resume: {resumes} manifest replay(s) "
+                     "— sorts re-entered at the merge phase")
+    sev = "critical" if recovers >= SPILL_CHURN_GATE else "warn"
+    return Finding("spill_churn", sev,
+                   f"spill tier churning: {recovers} recovery(ies) + "
+                   f"{resumes} crash resume(s) in one trace",
+                   evidence=cites, knob="SORT_SPILL_DIR",
+                   direction="set (move spill staging to a healthier "
+                             "volume; check dmesg for media errors)",
+                   value=float(churn), threshold=float(SPILL_CHURN_GATE))
 
 
 @_rule("breaker_flap")

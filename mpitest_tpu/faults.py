@@ -46,6 +46,19 @@ both algorithms):
                     the external sort's bad-disk drill)
 ``merge_drop``      drop one merged output chunk before the output fold
                     (store/merge.py — silent merge truncation)
+``spill_torn_write`` chop tail bytes off a run's key file at close —
+                    a torn write whose sidecar promises more bytes
+                    than disk holds (store/runs.py commit path)
+``spill_enospc``    raise ``OSError(ENOSPC)`` at the Nth spill write
+                    (``SORT_FAULT_ENOSPC_AT``) — the full-volume shape
+                    the typed capacity rejection must absorb
+``spill_bitrot``    flip one byte in a run's key body AFTER commit —
+                    at-rest decay the merge's read-back fold catches
+``manifest_torn``   drop the tail of one spill-manifest journal line —
+                    the crashed-mid-append shape replay skips loudly
+``merge_stall``     block ``SORT_FAULT_STALL_MS`` at merge entry — a
+                    merge wedged on a dying disk (the durability
+                    drill's deterministic SIGKILL barrier)
 ================  ==========================================================
 
 Wire-level chaos (ISSUE 11) is a separate family: :data:`WIRE_SITES`
@@ -68,9 +81,11 @@ impossible to miss.
 
 from __future__ import annotations
 
+import errno
 import itertools
 import math
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import TYPE_CHECKING, Callable
 
@@ -98,6 +113,20 @@ SITES = (
                        # disk / torn write the merge must catch
     "merge_drop",      # drop one merged output chunk before the output
                        # fold — silent truncation in the merge engine
+    # crash-durable spill tier (ISSUE 18, store/manifest.py + resume):
+    "spill_torn_write",  # chop tail bytes off a run's key file at
+                         # close — sidecar/manifest promise more bytes
+                         # than disk holds (re-spilled on blame)
+    "spill_enospc",      # OSError(ENOSPC) at the Nth spill write
+                         # (SORT_FAULT_ENOSPC_AT) — must surface as
+                         # the typed capacity rejection, never a 500
+    "spill_bitrot",      # flip one byte in a run's key body AFTER
+                         # commit — at-rest decay caught by the
+                         # merge's read-back fold
+    "manifest_torn",     # drop the tail of one manifest journal line
+                         # — replay must skip it loudly
+    "merge_stall",       # block SORT_FAULT_STALL_MS at merge entry —
+                         # the kill-resume drill's SIGKILL barrier
 )
 
 #: Sites applied at trace time inside the compiled SPMD program (the
@@ -490,6 +519,86 @@ def should_drop_merge_chunk(chunk_idx: int, n: int) -> bool:
     if reg is None or not reg.would_fire("merge_drop"):
         return False
     return reg.fire("merge_drop", chunk=chunk_idx, n=n)
+
+
+def spill_tear_bytes(body_bytes: int) -> int:
+    """Spill-commit hook (store/runs.py close path): number of tail
+    bytes to chop off the run's key file (0 = clean).  The sidecar (and
+    any manifest line) already promise the full length, so the torn run
+    is caught structurally — ``open_run`` / the merge's size check —
+    and blamed + re-spilled, or discarded by resume re-validation."""
+    reg = current()
+    if reg is None or body_bytes <= 0 \
+            or not reg.would_fire("spill_torn_write"):
+        return 0
+    word = reg.rand_word()
+    cut = min(1 + (word % 7), body_bytes)
+    if not reg.fire("spill_torn_write", cut=cut, body=body_bytes):
+        return 0
+    return cut
+
+
+def spill_bitrot_word() -> int | None:
+    """Post-commit bit-rot hook (store/runs.py close path): a nonzero
+    corruption word to XOR into the middle of the run's key body AFTER
+    the durable commit, or None when clean.  The on-disk bytes then
+    disagree with the sidecar — at-rest decay the merge's read-back
+    fold (and resume's ``verify_run``) must flag."""
+    reg = current()
+    if reg is None or not reg.would_fire("spill_bitrot"):
+        return None
+    word = reg.rand_word()
+    if not reg.fire("spill_bitrot", word=word):
+        return None
+    return word
+
+
+def maybe_spill_enospc(nbytes: int) -> None:
+    """Spill-write hook (store/runs.py append path): raise a real
+    ``OSError(ENOSPC)`` at the Nth write opportunity
+    (``SORT_FAULT_ENOSPC_AT``, 1-based) — the volume-full shape the
+    external driver must convert to the typed capacity rejection with
+    partial outputs deleted, never an untyped 500."""
+    reg = current()
+    if reg is None or not reg.would_fire("spill_enospc"):
+        return
+    at = int(knobs.get("SORT_FAULT_ENOSPC_AT"))
+    seen = int(getattr(reg, "_enospc_writes", 0)) + 1
+    reg._enospc_writes = seen  # type: ignore[attr-defined]
+    if seen < at:
+        return
+    if reg.fire("spill_enospc", write=seen, bytes=nbytes):
+        raise OSError(errno.ENOSPC,
+                      "No space left on device (injected spill_enospc)")
+
+
+def manifest_tear_cut(line_len: int) -> int:
+    """Manifest-journal hook (store/manifest.py commit path): number of
+    tail bytes of this journal line that never reach disk (0 = clean)
+    — the crashed-mid-append shape replay must skip loudly without
+    losing the committed lines before it."""
+    reg = current()
+    if reg is None or line_len <= 1 \
+            or not reg.would_fire("manifest_torn"):
+        return 0
+    cut = max(1, line_len // 2)
+    if not reg.fire("manifest_torn", cut=cut, line_len=line_len):
+        return 0
+    return cut
+
+
+def maybe_merge_stall() -> None:
+    """Merge-entry hook (store/external.py): block the merging thread
+    for ``SORT_FAULT_STALL_MS`` — a merge wedged on a dying disk.  The
+    durability drill arms this as its deterministic barrier: the
+    process is SIGKILLed mid-stall with every partition run already
+    durably committed, so the restart must resume at the merge."""
+    reg = current()
+    if reg is None or not reg.would_fire("merge_stall"):
+        return
+    ms = int(knobs.get("SORT_FAULT_STALL_MS"))
+    if reg.fire("merge_stall", ms=ms):
+        time.sleep(ms / 1e3)
 
 
 def maybe_corrupt_result(reg: FaultRegistry | None,

@@ -368,6 +368,10 @@ class SortPlan:
             # served by the spill tier, not rejected
             out["spilled"] = True
             out["spill_runs"] = _scalar(ext.actual.get("runs"))
+            # ISSUE 18: a retried request that warm-resumed from a
+            # journaled spill manifest says so in its reply digest
+            if _scalar(ext.actual.get("resumed")):
+                out["resumed"] = True
         # ISSUE 16: the doctor's plan-shaped verdicts (cap_thrash,
         # window_misfit) ride the digest so a mis-planned run
         # self-describes.  Lazy + best-effort: this module must stay

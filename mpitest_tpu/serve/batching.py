@@ -63,6 +63,10 @@ class ServeRequest:
     #: are disk-backed memmaps of the staged input and the dispatch
     #: runs the external sort; solo by construction.
     spill: bool = False
+    #: client-chosen dataset id (ISSUE 18): keys the spill tier's
+    #: journaled manifest, so a retried request of the same dataset
+    #: warm-resumes at the merge phase instead of re-sorting.
+    dataset: str | None = None
     #: wire/client-minted request trace id (ISSUE 10) — stamped on every
     #: span this request touches via ``spans.trace_context``.
     trace_id: str = ""

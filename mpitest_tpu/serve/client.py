@@ -120,7 +120,8 @@ class ServeClient:
              faults: str | None = None,
              trace_id: str | None = None,
              deadline_ms: float | None = None,
-             payload: np.ndarray | bytes | None = None) -> ServeReply:
+             payload: np.ndarray | bytes | None = None,
+             dataset_id: str | None = None) -> ServeReply:
         """Send one sort request; block for the reply.  A ``trace_id``
         is minted here when the caller supplies none — the client IS
         the wire layer, so every request carries one end to end (the
@@ -130,7 +131,10 @@ class ServeClient:
         dispatch.  ``payload`` (ISSUE 15) turns the request into a
         record sort: bytes (``n * width``) or an ``(n, width)`` uint8
         matrix of per-record payloads, returned permuted into key
-        order on ``reply.payload``."""
+        order on ``reply.payload``.  ``dataset_id`` (ISSUE 18) is a
+        stable client-chosen id keying the spill tier's journaled
+        manifest: a retried over-memory request reusing it resumes at
+        the merge phase (``resumed: true`` in the reply plan digest)."""
         arr = np.ascontiguousarray(arr).reshape(-1)
         n = int(arr.size)
         hdr: dict = {"v": WIRE_SCHEMA, "dtype": arr.dtype.name,
@@ -159,6 +163,8 @@ class ServeClient:
             hdr["faults"] = faults
         if deadline_ms is not None:
             hdr["deadline_ms"] = float(deadline_ms)
+        if dataset_id is not None:
+            hdr["dataset_id"] = dataset_id
         self.sock.sendall(json.dumps(hdr).encode("utf-8") + b"\n"
                           + arr.tobytes() + pay_bytes)
         line = self._rfile.readline()

@@ -63,6 +63,7 @@ ordinary ``SORT_TRACE`` stream.
 
 from __future__ import annotations
 
+import errno
 import json
 import math
 import os
@@ -346,7 +347,8 @@ class ServerCore:
                             tracer=self.tracer,
                             budget=self.spill_budget,
                             sink="file",
-                            out_name=f"out_{mint_trace_id()}")
+                            out_name=f"out_{mint_trace_id()}",
+                            dataset=req.dataset)
                         out, out_pay, out_run = None, None, res.out_run
                     elif req.payload_width:
                         # record sort (ISSUE 15): key+payload through
@@ -382,6 +384,16 @@ class ServerCore:
             # problem, never the client's request
             req.fail(ERR_INTERNAL if isinstance(e, RunFormatError)
                      else ERR_BAD_REQUEST, str(e))
+        except OSError as e:
+            # mid-merge disk-full (ISSUE 18): the external sort already
+            # deleted its partials; the client sees the same retryable
+            # rejection vocabulary as admission backpressure, never an
+            # untyped 500
+            if e.errno == errno.ENOSPC:
+                req.fail(ERR_BACKPRESSURE, str(e))
+            else:
+                flight_recorder.dump_on_error("serve_internal")
+                req.fail(ERR_INTERNAL, f"{type(e).__name__}: {e}")
         except Exception as e:  # noqa: BLE001 — one request's problem,
             # never the server's; an UNtyped failure is an incident the
             # flight recorder must document (api.sort dumps the typed
@@ -515,12 +527,14 @@ class ServerCore:
                            trace_id: str, deadline: float | None = None,
                            payload: np.ndarray | None = None,
                            spill: bool = False,
+                           dataset: str | None = None,
                            ) -> tuple[str, Any, dict]:
         """Dispatch an ALREADY-ADMITTED request and wait for completion.
         The caller owns the admission release.  ``payload`` (ISSUE 15)
         routes through the record sort; ``spill`` through the
         out-of-core tier — both solo by construction (the packed path
-        is keys-only and in-memory)."""
+        is keys-only and in-memory).  ``dataset`` (ISSUE 18) keys the
+        spill tier's journaled manifest for crash/retry resume."""
         width = int(payload.shape[1]) if payload is not None else 0
         req = ServeRequest(
             arr=arr, dtype=np.dtype(arr.dtype),
@@ -528,7 +542,8 @@ class ServerCore:
             batchable=(faults_spec is None and not spill and width == 0
                        and int(arr.size) <= self.batch_keys),
             faults=faults_spec, trace_id=trace_id, deadline=deadline,
-            payload=payload, payload_width=width, spill=spill)
+            payload=payload, payload_width=width, spill=spill,
+            dataset=dataset)
         # serve auto-tuning (ISSUE 14): every admitted request feeds
         # the rolling mix the window/bucket policies learn from
         self._tuner_observe(int(arr.size), req.dtype.name)
@@ -913,6 +928,17 @@ class ServerCore:
                        f"bad trace_id {raw_tid!r} (1-64 chars of "
                        "[A-Za-z0-9_-])", keep=False)
         tid = raw_tid or mint_trace_id()
+        # dataset_id (ISSUE 18): client-chosen stable id keying the
+        # spill tier's journaled manifest — a retried request with the
+        # same id warm-resumes at the merge phase.  Same grammar as
+        # trace_id (it becomes a spill-dir filename stem).
+        dataset_id = hdr.get("dataset_id")
+        if dataset_id is not None and (
+                not isinstance(dataset_id, str)
+                or not _TRACE_ID_RE.fullmatch(dataset_id)):
+            return err(ERR_BAD_REQUEST,
+                       f"bad dataset_id {dataset_id!r} (1-64 chars of "
+                       "[A-Za-z0-9_-])", keep=False)
         try:
             dtype = np.dtype(str(hdr.get("dtype", "int32")))
             from mpitest_tpu.ops.keys import codec_for
@@ -988,7 +1014,8 @@ class ServerCore:
             # headroom (3x the request) ⇒ the ordinary typed rejection
             # below, never an untyped OSError mid-stage.
             return self._spill_wire(t0, attrs, rfile, conn, n, dtype,
-                                    width, algo, tid, deadline, err)
+                                    width, algo, tid, deadline, err,
+                                    dataset_id)
         try:
             self._admit(nbytes)
         except AdmissionReject as e:
@@ -1069,7 +1096,8 @@ class ServerCore:
                     conn: "socket.socket | None", n: int,
                     dtype: np.dtype, width: int, algo: str | None,
                     tid: str, deadline: float | None,
-                    err: Any) -> tuple[dict, Any, bool]:
+                    err: Any,
+                    dataset_id: str | None = None) -> tuple[dict, Any, bool]:
         """The wire spill tier: stream the over-budget request's bytes
         straight from the socket into spill-dir staging files (host
         memory never holds them), dispatch the external sort over the
@@ -1121,9 +1149,17 @@ class ServerCore:
             except runlib.RunFormatError as e:
                 self._finish(t0, attrs, ERR_INTERNAL, str(e))
                 return err(ERR_INTERNAL, str(e), keep=False)
+            except OSError as e:
+                # ENOSPC while staging (ISSUE 18): typed retryable
+                # rejection, partial staging files already unlinked
+                stage.abort()
+                if e.errno != errno.ENOSPC:
+                    raise
+                self._finish(t0, attrs, ERR_BACKPRESSURE, str(e))
+                return err(ERR_BACKPRESSURE, str(e), keep=False)
             status, result, attrs = self._dispatch_admitted(
                 t0, attrs, arr, algo, None, tid, deadline, payload=pay,
-                spill=True)
+                spill=True, dataset=dataset_id)
         finally:
             self.admission.release(0)
         if status != "ok":

@@ -32,15 +32,29 @@ Integrity ladder (the external twin of the supervisor ladder):
    ``sort_external_recoveries_total``); a second failure raises the
    typed ``SortIntegrityError`` — never silent wrong bytes.
 
-Telemetry: registered ``external.run`` / ``external.merge`` spans (+
-the ``external.recover`` event) ride the ordinary span stream and feed
+Durability (ISSUE 18): a caller-supplied ``dataset`` id opts the sort
+into the crash-durable path — every spilled run commits via write-temp
+→ fsync → ``os.replace`` → fsync(dir) and is journaled in an
+append-only manifest (``store/manifest.py``), so completed runs + the
+journal ARE a checkpoint: a killed process (or a retried spilled serve
+request) replays the manifest, re-validates every committed run and
+re-enters at the merge phase instead of re-sorting.  The startup GC
+(:func:`gc_spill_dir`) reclaims age-gated orphans no live manifest
+references, and a mid-sort ``ENOSPC`` surfaces as the typed
+:class:`SpillCapacityError` with partial outputs deleted.
+
+Telemetry: registered ``external.run`` / ``external.merge`` /
+``external.resume`` / ``external.gc`` spans (+ the
+``external.recover`` event) ride the ordinary span stream and feed
 the ``sort_external_*`` live metrics through the span bridge; the plan
 record (ISSUE 12) grows an ``external`` decision so ``--explain`` and
-the serve plan digest (``spilled: true``) name the tier that ran.
+the serve plan digest (``spilled: true`` / ``resumed: true``) name the
+tier that ran.
 """
 
 from __future__ import annotations
 
+import errno
 import os
 import tempfile
 import time
@@ -53,6 +67,7 @@ import numpy as np
 from mpitest_tpu.models import plan as plan_mod
 from mpitest_tpu.models.supervisor import SortIntegrityError
 from mpitest_tpu.ops.keys import codec_for
+from mpitest_tpu.store import manifest as mfstlib
 from mpitest_tpu.store import merge as mergelib
 from mpitest_tpu.store import runs as runlib
 from mpitest_tpu.utils import knobs
@@ -69,6 +84,23 @@ MIN_CHUNK_ELEMS = 1 << 10
 #: Recovery budget: full merge attempts before the typed error.
 MERGE_ATTEMPTS = 2
 
+#: Spill-artifact suffixes the orphan GC may reclaim (age-gated,
+#: manifest-referenced files excluded) — run files, staging files,
+#: durable-commit temps, and journals themselves.
+GC_SUFFIXES = (".run", ".pay", ".fpr.json", ".spill", ".tmp",
+               mfstlib.MANIFEST_SUFFIX)
+
+
+class SpillCapacityError(OSError):
+    """The spill volume ran out of space mid-sort (a real — or injected
+    ``spill_enospc`` — ``ENOSPC`` during a run/merge/staging write).
+    Partial outputs are deleted before this raises; the serve tier maps
+    it to the typed retryable ``backpressure`` rejection, mirroring the
+    admission-time ``bytes`` bound — never an untyped 500."""
+
+    def __init__(self, detail: str) -> None:
+        super().__init__(errno.ENOSPC, detail)
+
 
 @dataclass
 class ExternalResult:
@@ -84,6 +116,9 @@ class ExternalResult:
     keys: np.ndarray | None = None        # sink="array"
     payload: np.ndarray | None = None     # sink="array", records only
     out_run: "runlib.RunInfo | None" = None   # sink="file"
+    #: runs re-validated from a journaled manifest instead of being
+    #: re-sorted (ISSUE 18 crash resume; 0 = cold run)
+    resumed_runs: int = 0
 
 
 def _budget() -> int:
@@ -142,11 +177,11 @@ def _spans(tracer: Any):
 
 def _spill_one(idx: int, keys: np.ndarray, pay: np.ndarray | None,
                spill_dir: str, algorithm: str, mesh: Any, tracer: Any,
-               ) -> "runlib.RunInfo":
+               durable: bool = False) -> "runlib.RunInfo":
     t0 = time.perf_counter()
     out_k, out_p = _sort_chunk(keys, pay, algorithm, mesh, tracer)
     info = runlib.write_run(spill_dir, f"r{os.getpid():x}_{idx:05d}",
-                            out_k, out_p)
+                            out_k, out_p, durable=durable)
     spans = _spans(tracer)
     if spans is not None:
         spans.record("external.run", t0, time.perf_counter() - t0,
@@ -172,9 +207,15 @@ def _merge_level(level: "list[runlib.RunInfo]", spill_dir: str,
         w = runlib.RunStreamWriter(
             spill_dir, f"m{os.getpid():x}_{pass_idx}_{gi:05d}",
             dtype, width)
-        for kws, pws in mergelib.merge_runs(group, ch):
-            w.append_words(kws, pws)
-        info = w.close()
+        try:
+            for kws, pws in mergelib.merge_runs(group, ch):
+                w.append_words(kws, pws)
+            info = w.close()
+        except BaseException:
+            # an ENOSPC (or integrity failure) mid-pass must not leak
+            # the half-written intermediate run
+            w.abort()
+            raise
         spans = _spans(tracer)
         if spans is not None:
             spans.record("external.merge", t0,
@@ -198,11 +239,19 @@ def external_sort(
     fanin: int | None = None,
     sink: "str | Callable[[np.ndarray, np.ndarray | None], None]" = "array",
     out_name: str = "merged",
+    dataset: str | None = None,
 ) -> ExternalResult:
     """Externally sort host keys ``x`` (optionally with per-record
     ``payload`` bytes) under a byte ``budget`` (default
     ``SORT_MEM_BUDGET``; must be > 0 — the external path never engages
     implicitly).
+
+    ``dataset`` (ISSUE 18) opts the sort into the crash-durable path:
+    every spilled run commits durably and is journaled in a manifest
+    keyed by the id, and a retried/restarted sort of the same dataset
+    replays the journal, re-validates the committed runs and re-enters
+    at the merge phase instead of re-sorting (``SORT_RESUME=off``
+    disables both halves).
 
     ``sink`` selects where the merged output goes: ``"array"``
     materializes ``result.keys`` (+ ``result.payload``) — bit-identical
@@ -228,7 +277,7 @@ def external_sort(
     return _external_core(chunks, n, dtype, width, algorithm=algorithm,
                           mesh=mesh, tracer=tracer, budget=budget,
                           spill_dir=spill_dir, fanin=fanin, sink=sink,
-                          out_name=out_name)
+                          out_name=out_name, dataset=dataset)
 
 
 def external_sort_file(
@@ -244,6 +293,7 @@ def external_sort_file(
     sink: "str | Callable[[np.ndarray, np.ndarray | None], None]" = "array",
     out_name: str = "merged",
     sink_factory: Any = None,
+    dataset: str | None = None,
 ) -> ExternalResult:
     """External sort of a key FILE — SORTBIN1 or reference text —
     without ever materializing it: chunks stream through
@@ -265,7 +315,8 @@ def external_sort_file(
     return _external_core(chunks, None, dtype, 0, algorithm=algorithm,
                           mesh=mesh, tracer=tracer, budget=budget,
                           spill_dir=spill_dir, fanin=fanin, sink=sink,
-                          out_name=out_name, sink_factory=sink_factory)
+                          out_name=out_name, sink_factory=sink_factory,
+                          dataset=dataset)
 
 
 def _external_core(
@@ -284,6 +335,7 @@ def _external_core(
     sink: "str | Callable[[np.ndarray, np.ndarray | None], None]",
     out_name: str,
     sink_factory: "Callable[[int], Callable[[np.ndarray, np.ndarray | None], None]] | None" = None,
+    dataset: str | None = None,
 ) -> ExternalResult:
     from mpitest_tpu.utils.trace import Tracer
 
@@ -311,7 +363,58 @@ def _external_core(
     supervision.wire_registry(reg, tracer)
     spans = _spans(tracer)
 
+    resume_on = dataset is not None and knobs.get("SORT_RESUME") != "off"
+
     with faultlib.active(reg):
+        # ---- crash resume (ISSUE 18) --------------------------------
+        # a journaled manifest from a killed (or typed-failed-and-
+        # retried) sort of the SAME dataset is a checkpoint: replay it,
+        # re-validate every committed run (structure + sidecar fold),
+        # and skip the sort phase for every chunk that survives.
+        resumed: dict[int, runlib.RunInfo] = {}
+        resumed_meta: dict[int, mfstlib.ManifestRun] = {}
+        mwriter: mfstlib.ManifestWriter | None = None
+        if resume_on:
+            gc_spill_dir(spill_dir, tracer=tracer)
+            t0 = time.perf_counter()
+            m = mfstlib.load(mfstlib.manifest_path(spill_dir, dataset))
+            if m is not None and (m.dtype == dtype.name
+                                  and m.payload_width == width
+                                  and m.chunk_elems == chunk_elems
+                                  and (n_hint is None or m.n is None
+                                       or m.n == n_hint)):
+                for mr in m.runs:
+                    try:
+                        info = runlib.open_run(mr.path)
+                        ok = (info.n == mr.n
+                              and info.fingerprint == mr.fingerprint
+                              and runlib.verify_run(info))
+                    except runlib.RunVersionError:
+                        raise  # version skew is typed, never silent
+                    except (runlib.RunFormatError, OSError):
+                        ok = False  # torn/missing partial: discarded
+                    if ok:
+                        resumed[mr.chunk] = info
+                        resumed_meta[mr.chunk] = mr
+                    else:
+                        tracer.verbose(
+                            f"resume: discarding invalid committed "
+                            f"run {mr.path!r} (chunk {mr.chunk})")
+                        # the damaged files must not linger: this
+                        # chunk re-spills to a fresh path below
+                        runlib.remove_run_paths(mr.path)
+                if spans is not None:
+                    spans.record(
+                        "external.resume", t0,
+                        time.perf_counter() - t0, dataset=dataset,
+                        committed=len(m.runs), valid=len(resumed),
+                        skipped_lines=m.skipped_lines)
+            mwriter = mfstlib.ManifestWriter(
+                spill_dir, dataset, dtype=dtype.name, n=n_hint,
+                payload_width=width, algorithm=algorithm,
+                chunk_elems=chunk_elems, budget=budget, fanin=fanin,
+                resumed=[resumed_meta[c] for c in sorted(resumed_meta)])
+
         # ---- partition + spill --------------------------------------
         run_infos: list[runlib.RunInfo] = []
         #: source chunk index behind each run — the recovery path
@@ -319,44 +422,81 @@ def _external_core(
         #: so run order and chunk order can differ)
         chunk_of_run: list[int] = []
         n = 0
-        for idx, (kchunk, pchunk) in enumerate(chunks_fn(chunk_elems)):
-            kchunk = np.asarray(kchunk, dtype).reshape(-1)
-            if kchunk.size == 0:
-                continue
-            run_infos.append(_spill_one(idx, kchunk, pchunk, spill_dir,
-                                        algorithm, mesh, tracer))
-            chunk_of_run.append(idx)
-            n += int(kchunk.size)
-        if n_hint is not None and n != n_hint:
-            raise SortIntegrityError(
-                f"partition saw {n} records, expected {n_hint}")
-
-        if not run_infos:
-            res = ExternalResult(0, dtype, width, 0, 0, 0, 0,
-                                 keys=np.empty(0, dtype),
-                                 payload=(np.zeros((0, width), np.uint8)
-                                          if width else None))
-            _finish_plan(tracer, res, budget, fanin)
-            return res
-
-        disk0 = sum(r.disk_bytes for r in run_infos)
-        expected_fp = run_infos[0].fingerprint
-        for r in run_infos[1:]:
-            expected_fp = expected_fp.combine(r.fingerprint)
-
-        # ---- merge (+ bounded integrity recovery) -------------------
-        # partition runs are dataset-sized: deleted on EVERY exit path
-        # below (the success case and the typed failure alike — the
-        # flight recorder, not the disk, carries the postmortem)
+        resumed_count = 0
         try:
-            return _merge_with_recovery(
-                chunks_fn, chunk_elems, run_infos, chunk_of_run, n,
-                disk0, expected_fp, spill_dir, budget, fanin, dtype,
-                width, codec, algorithm, mesh, sink, sink_factory,
-                out_name, tracer, spans)
-        finally:
+            for idx, (kchunk, pchunk) in enumerate(
+                    chunks_fn(chunk_elems)):
+                kchunk = np.asarray(kchunk, dtype).reshape(-1)
+                if kchunk.size == 0:
+                    continue
+                prev = resumed.get(idx)
+                if prev is not None and prev.n == int(kchunk.size):
+                    # checkpoint hit: the committed run IS this chunk
+                    # sorted — re-enter at the merge without re-sorting
+                    run_infos.append(prev)
+                    chunk_of_run.append(idx)
+                    n += int(kchunk.size)
+                    resumed_count += 1
+                    continue
+                info = _spill_one(idx, kchunk, pchunk, spill_dir,
+                                  algorithm, mesh, tracer,
+                                  durable=mwriter is not None)
+                if mwriter is not None:
+                    mwriter.commit_run(idx, info)
+                run_infos.append(info)
+                chunk_of_run.append(idx)
+                n += int(kchunk.size)
+            if n_hint is not None and n != n_hint:
+                raise SortIntegrityError(
+                    f"partition saw {n} records, expected {n_hint}")
+
+            if not run_infos:
+                res = ExternalResult(0, dtype, width, 0, 0, 0, 0,
+                                     keys=np.empty(0, dtype),
+                                     payload=(np.zeros((0, width),
+                                                       np.uint8)
+                                              if width else None))
+                _finish_plan(tracer, res, budget, fanin)
+                return res
+
+            disk0 = sum(r.disk_bytes for r in run_infos)
+            expected_fp = run_infos[0].fingerprint
+            for r in run_infos[1:]:
+                expected_fp = expected_fp.combine(r.fingerprint)
+
+            # ---- merge (+ bounded integrity recovery) ---------------
+            # partition runs are dataset-sized: deleted on EVERY exit
+            # path below (the success case and the typed failure alike
+            # — the flight recorder, not the disk, carries the
+            # postmortem).  Only a CRASH skips this cleanup, and that
+            # is exactly what the manifest + resume exist for.
+            try:
+                return _merge_with_recovery(
+                    chunks_fn, chunk_elems, run_infos, chunk_of_run, n,
+                    disk0, expected_fp, spill_dir, budget, fanin, dtype,
+                    width, codec, algorithm, mesh, sink, sink_factory,
+                    out_name, tracer, spans, mwriter, resumed_count)
+            finally:
+                for r in run_infos:
+                    runlib.remove_run(r)
+        except BaseException as e:
+            # a FAILED sort (typed or not) never leaves partial runs
+            # behind — only a crash does, and the manifest + resume
+            # exist for exactly that.  The merge path already removed
+            # its runs in the finally above; remove_run is idempotent.
             for r in run_infos:
                 runlib.remove_run(r)
+            if isinstance(e, OSError) and e.errno == errno.ENOSPC \
+                    and not isinstance(e, SpillCapacityError):
+                # in-flight partial outputs were already deleted at
+                # their write sites (writer.abort); surface the typed
+                # retryable shape
+                raise SpillCapacityError(
+                    f"spill volume full ({spill_dir!r}): {e}") from e
+            raise
+        finally:
+            if mwriter is not None:
+                mwriter.delete()
 
 
 def _merge_with_recovery(
@@ -380,9 +520,21 @@ def _merge_with_recovery(
     out_name: str,
     tracer: Any,
     spans: Any,
+    mwriter: "mfstlib.ManifestWriter | None" = None,
+    resumed_count: int = 0,
 ) -> ExternalResult:
     """The bounded merge/recovery loop of :func:`_external_core` (split
     out so the caller owns partition-run cleanup on every exit)."""
+
+    def _run_ok(r: "runlib.RunInfo") -> bool:
+        # blame must survive structurally-torn runs too: a truncated
+        # file raises RunFormatError from the chunk reader, which for
+        # blame purposes is simply "bad run, re-spill it"
+        try:
+            return runlib.verify_run(r)
+        except (runlib.RunFormatError, OSError):
+            return False
+
     recoveries = 0
     merge_passes = 0
     out: ExternalResult | None = None
@@ -407,14 +559,20 @@ def _merge_with_recovery(
             # INTERMEDIATE merge run cannot be re-spilled directly
             # — blame falls back to scanning the originals)
             bad = ([e.info] if e.info in run_infos
-                   else [r for r in run_infos
-                         if not runlib.verify_run(r)])
+                   else [r for r in run_infos if not _run_ok(r)])
+            last_err = str(e)
+        except runlib.RunVersionError:
+            raise  # version skew is typed all the way out, never blamed
+        except runlib.RunFormatError as e:
+            # structural damage mid-merge (the spill_torn_write shape:
+            # disk holds fewer bytes than the sidecar promises) —
+            # blame by scanning, exactly like a fold mismatch
+            bad = [r for r in run_infos if not _run_ok(r)]
             last_err = str(e)
         except SortIntegrityError as e:
             # output-side mismatch (merge_drop shape): blame by
             # scanning every run against its sidecar
-            bad = [r for r in run_infos
-                   if not runlib.verify_run(r)]
+            bad = [r for r in run_infos if not _run_ok(r)]
             last_err = str(e)
         if attempt >= MERGE_ATTEMPTS:
             break
@@ -434,7 +592,16 @@ def _merge_with_recovery(
             src = next(islice(chunks_fn(chunk_elems), ci, ci + 1))
             run_infos[i] = _spill_one(ci, np.asarray(src[0], dtype),
                                       src[1], spill_dir, algorithm,
-                                      mesh, tracer)
+                                      mesh, tracer,
+                                      durable=mwriter is not None)
+            if mwriter is not None:
+                # journal the replacement (replay is last-wins per
+                # chunk, so the blamed run's old line is superseded)
+                mwriter.commit_run(ci, run_infos[i])
+            if r.path != run_infos[i].path:
+                # a blamed RESUMED run kept its old (other-pid) name;
+                # the replacement got a fresh one — drop the old files
+                runlib.remove_run(r)
         expected_fp = run_infos[0].fingerprint
         for r in run_infos[1:]:
             expected_fp = expected_fp.combine(r.fingerprint)
@@ -447,6 +614,7 @@ def _merge_with_recovery(
     out.disk_bytes = disk0
     out.recoveries = recoveries
     out.merge_passes = merge_passes
+    out.resumed_runs = resumed_count
     tracer.counters["external_runs"] = out.runs
     tracer.counters["external_disk_bytes"] = out.disk_bytes
     tracer.counters["external_merge_passes"] = out.merge_passes
@@ -472,8 +640,13 @@ def _merge_all(
     """Fan-in-bounded merge of all runs + the output-side verification
     (fingerprint vs combined sidecars, boundary-inclusive sortedness).
     Raises typed integrity errors; never returns unverified bytes."""
+    from mpitest_tpu import faults as faultlib
     from mpitest_tpu.models.records import words_to_payload
 
+    # merge_stall drill (ISSUE 18): the durability selftest's SIGKILL
+    # barrier — every partition run is committed, the merge has not
+    # consumed them yet
+    faultlib.maybe_merge_stall()
     spans = _spans(tracer)
     level = list(run_infos)
     merge_passes = 0
@@ -575,6 +748,60 @@ def _merge_all(
     return res, merge_passes
 
 
+def gc_spill_dir(spill_dir: str | None = None, *,
+                 age_s: float | None = None, tracer: Any = None) -> int:
+    """Startup GC (ISSUE 18): reclaim orphaned spill artifacts — run /
+    staging / temp / journal files under ``spill_dir`` that no live
+    manifest references.  A SIGKILLed process leaks its nonce-named
+    partials forever otherwise.  Age-gated (``SORT_SPILL_GC_AGE_S``):
+    a concurrent sort's fresh files are never swept.  Returns the
+    number of files reclaimed (the ``external.gc`` span feeds
+    ``sort_external_orphans_reclaimed_total``)."""
+    d = resolve_spill_dir(spill_dir)
+    if age_s is None:
+        age_s = float(knobs.get("SORT_SPILL_GC_AGE_S"))
+    t0 = time.perf_counter()
+    live: set[str] = set()
+    for m in mfstlib.live_manifests(d):
+        live.add(m.path)
+        for mr in m.runs:
+            live.add(mr.path)
+            live.add(mr.path + ".pay")
+            live.add(mr.path + ".fpr.json")
+    now = time.time()
+    reclaimed = 0
+    freed = 0
+    try:
+        names = sorted(os.listdir(d))
+    except OSError:
+        return 0
+    for fn in names:
+        if not fn.endswith(GC_SUFFIXES):
+            continue
+        p = os.path.join(d, fn)
+        if p in live:
+            continue
+        try:
+            st = os.stat(p)
+        except OSError:
+            continue
+        if now - st.st_mtime < age_s:
+            continue
+        try:
+            os.unlink(p)
+        except OSError:
+            continue
+        reclaimed += 1
+        freed += int(st.st_size)
+    if reclaimed and tracer is not None:
+        spans = _spans(tracer)
+        if spans is not None:
+            spans.record("external.gc", t0, time.perf_counter() - t0,
+                         dir=d, reclaimed=reclaimed, bytes=freed,
+                         age_s=float(age_s))
+    return reclaimed
+
+
 def _finish_plan(tracer: Any, res: ExternalResult, budget: int,
                  fanin: int) -> None:
     """Record the external plan decision (ISSUE 12): the tier choice,
@@ -590,7 +817,8 @@ def _finish_plan(tracer: Any, res: ExternalResult, budget: int,
                 payload_width=res.payload_width)
     plan.actual("external", runs=res.runs, disk_bytes=res.disk_bytes,
                 merge_passes=res.merge_passes,
-                recoveries=res.recoveries)
+                recoveries=res.recoveries,
+                resumed=res.resumed_runs)
     if res.recoveries:
         plan.bump("external", "recoveries", float(res.recoveries))
     plan.finalize()

@@ -51,6 +51,19 @@ PAY_HEADER_LEN = 16
 #: Sidecar schema tag.
 FP_SCHEMA = "sortfp1"
 
+#: Run-framing format version (ISSUE 18), stamped into reserved byte 10
+#: of the SORTBIN1 header and byte 12 of the SORTPAY1 header (both
+#: engines validate only magic + kind + itemsize, so versioned runs
+#: stay readable by every existing SORTBIN1 consumer), plus the sidecar
+#: and the spill manifest.  Version 0 is the pre-versioning framing
+#: (reserved bytes all zero) — still readable.
+RUN_FORMAT_VERSION = 1
+COMPAT_FORMAT_VERSIONS = (0, 1)
+
+#: Byte offsets of the version stamp inside the two 16-byte headers.
+BIN_VERSION_OFF = 10
+PAY_VERSION_OFF = 12
+
 
 class RunFormatError(ValueError):
     """A run file (or its payload/sidecar) is structurally invalid —
@@ -58,8 +71,53 @@ class RunFormatError(ValueError):
     Always names the offending path."""
 
 
+class RunVersionError(RunFormatError):
+    """A run file / sidecar / manifest carries a ``format_version``
+    this build cannot read.  Always names BOTH versions — the file's
+    and ours — so an upgrade mismatch is diagnosable from the message
+    alone.  A distinct type so crash-resume can re-sort around disk
+    *damage* while still surfacing version skew typed: damage is
+    recoverable from source, silent cross-version misreads are not."""
+
+
+def fsync_dir(path: str) -> None:
+    """Durably commit a directory's entries (the rename half of the
+    write-temp → fsync → ``os.replace`` → fsync(dir) protocol).
+    Best-effort: filesystems without directory fsync just no-op."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _check_format_version(ver: int, path: str) -> None:
+    if ver not in COMPAT_FORMAT_VERSIONS:
+        raise RunVersionError(
+            f"run file {path!r} is format_version {ver}; this build "
+            f"reads {COMPAT_FORMAT_VERSIONS} and writes "
+            f"{RUN_FORMAT_VERSION}")
+
+
+def _run_bin_header(dtype: np.dtype) -> bytes:
+    """The SORTBIN1 header with the run format version stamped into
+    reserved byte 10 (``kio._bin_header`` zeroes all six reserved
+    bytes, so pre-versioning files read back as version 0)."""
+    h = bytearray(kio._bin_header(dtype))
+    h[BIN_VERSION_OFF] = RUN_FORMAT_VERSION
+    return bytes(h)
+
+
 def _pay_header(width: int) -> bytes:
-    return PAY_MAGIC + int(width).to_bytes(4, "little") + b"\0" * 4
+    h = bytearray(PAY_MAGIC + int(width).to_bytes(4, "little")
+                  + b"\0" * 4)
+    h[PAY_VERSION_OFF] = RUN_FORMAT_VERSION
+    return bytes(h)
 
 
 @dataclass(frozen=True)
@@ -101,12 +159,22 @@ class RunStreamWriter:
 
     The ``spill_corrupt`` fault site fires on the FIRST appended chunk
     (after its fold, before its write) — deterministic placement, same
-    contract as ``faults.maybe_poison_chunk``."""
+    contract as ``faults.maybe_poison_chunk``.
+
+    ``durable=True`` (the manifest-journaled path, ISSUE 18) writes
+    ``*.tmp`` names and commits at :meth:`close` via fsync(file) →
+    ``os.replace`` → fsync(dir), per file (keys, payload, sidecar) —
+    a crash leaves either a complete published run or invisible temp
+    files the startup GC reclaims, never a half-run under a final
+    name."""
 
     def __init__(self, spill_dir: str, name: str, dtype: np.dtype,
-                 payload_width: int = 0) -> None:
+                 payload_width: int = 0, durable: bool = False) -> None:
         os.makedirs(spill_dir, exist_ok=True)
         self.path = os.path.join(spill_dir, f"{name}.run")
+        self.durable = bool(durable)
+        self._dir = spill_dir
+        self._suffix = ".tmp" if self.durable else ""
         self.dtype = np.dtype(dtype)
         self.codec = codec_for(self.dtype)
         self.payload_width = int(payload_width)
@@ -114,12 +182,12 @@ class RunStreamWriter:
         self.disk_bytes = 0
         self._fp: Fingerprint | None = None
         self._chunks = 0
-        self._kf = open(self.path, "wb")
-        self._kf.write(kio._bin_header(self.dtype))
+        self._kf = open(self.path + self._suffix, "wb")
+        self._kf.write(_run_bin_header(self.dtype))
         self.disk_bytes += kio.BIN_HEADER_LEN
         self._pf = None
         if self.payload_width:
-            self._pf = open(self.path + ".pay", "wb")
+            self._pf = open(self.path + ".pay" + self._suffix, "wb")
             self._pf.write(_pay_header(self.payload_width))
             self.disk_bytes += PAY_HEADER_LEN
 
@@ -150,6 +218,7 @@ class RunStreamWriter:
         if self._chunks == 0:
             key_bytes = faults.maybe_corrupt_spill(key_bytes)
         self._chunks += 1
+        faults.maybe_spill_enospc(len(key_bytes))
         self._kf.write(key_bytes)
         self.disk_bytes += len(key_bytes)
         if pay is not None:
@@ -170,7 +239,32 @@ class RunStreamWriter:
                                    self.payload_width)
         self.append(keys, pay)
 
+    def abort(self) -> None:
+        """Close + delete everything this writer may have produced
+        (both temp and published names) — the ENOSPC / failed-merge
+        cleanup path: a dead attempt must not leak dataset-sized
+        partials under either naming."""
+        for f in (self._kf, self._pf):
+            try:
+                if f is not None:
+                    f.close()
+            except OSError:
+                pass
+        for base in (self.path, self.path + ".pay",
+                     self.path + ".fpr.json"):
+            for p in ((base, base + ".tmp") if self.durable
+                      else (base,)):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+
     def close(self) -> RunInfo:
+        if self.durable:
+            for f in (self._kf, self._pf):
+                if f is not None:
+                    f.flush()
+                    os.fsync(f.fileno())
         self._kf.close()
         if self._pf is not None:
             self._pf.close()
@@ -178,18 +272,53 @@ class RunStreamWriter:
             tuple(np.empty(0, np.uint32)
                   for _ in range(self.codec.n_words)),
             ())
-        with open(self.path + ".fpr.json", "w") as f:
+        sc_path = self.path + ".fpr.json"
+        with open(sc_path + self._suffix, "w") as f:
             json.dump({"v": FP_SCHEMA, "n": self.n,
                        "dtype": self.dtype.name,
                        "payload_width": self.payload_width,
+                       "format_version": RUN_FORMAT_VERSION,
                        "count": fp.count,
                        "xors": list(fp.xors), "sums": list(fp.sums)}, f)
+            if self.durable:
+                f.flush()
+                os.fsync(f.fileno())
+        if self.durable:
+            # publish: fsync'd temp → final name → directory entry.
+            # order keys/payload before sidecar — a sidecar must never
+            # describe files that do not exist yet
+            os.replace(self.path + ".tmp", self.path)
+            if self.payload_width:
+                os.replace(self.path + ".pay.tmp", self.path + ".pay")
+            os.replace(sc_path + ".tmp", sc_path)
+            fsync_dir(self._dir)
+        # disk-fault drills (ISSUE 18), applied to the PUBLISHED file:
+        # a torn tail (bytes that never really hit the platter) and
+        # post-commit bit rot — both leave the sidecar/manifest
+        # promising bytes the disk no longer honestly holds
+        body = self.disk_bytes - kio.BIN_HEADER_LEN \
+            - (PAY_HEADER_LEN if self.payload_width else 0) \
+            - (self.n * self.payload_width)
+        cut = faults.spill_tear_bytes(body)
+        if cut:
+            os.truncate(self.path,
+                        kio.BIN_HEADER_LEN + max(0, body - cut))
+        rot = faults.spill_bitrot_word()
+        if rot is not None and body > 0:
+            off = kio.BIN_HEADER_LEN + body // 2
+            with open(self.path, "r+b") as f:
+                f.seek(off)
+                b = f.read(1)
+                if b:
+                    f.seek(off)
+                    f.write(bytes([b[0] ^ ((rot & 0xFF) or 0x5A)]))
         return RunInfo(self.path, self.n, self.dtype,
                        self.payload_width, fp, self.disk_bytes)
 
 
 def write_run(spill_dir: str, name: str, keys_sorted: np.ndarray,
-              payload_sorted: np.ndarray | None = None) -> RunInfo:
+              payload_sorted: np.ndarray | None = None,
+              durable: bool = False) -> RunInfo:
     """Persist one sorted run: keys as SORTBIN1, payload (optional) as
     SORTPAY1, fingerprint sidecar folded from the HOST words before any
     byte reaches disk.  ``payload_sorted`` is a ``(n, width)`` uint8
@@ -207,9 +336,15 @@ def write_run(spill_dir: str, name: str, keys_sorted: np.ndarray,
                 f"payload must be (n, width) uint8; got {pay.shape} for "
                 f"{int(keys_sorted.size)} records")
         width = int(pay.shape[1])
-    w = RunStreamWriter(spill_dir, name, keys_sorted.dtype, width)
-    w.append(keys_sorted, payload_sorted if width else None)
-    return w.close()
+    w = RunStreamWriter(spill_dir, name, keys_sorted.dtype, width,
+                        durable=durable)
+    try:
+        w.append(keys_sorted, payload_sorted if width else None)
+        return w.close()
+    except OSError:
+        # ENOSPC mid-write (real or injected): never leak the partial
+        w.abort()
+        raise
 
 
 def _load_sidecar(path: str) -> tuple[dict, Fingerprint]:
@@ -232,6 +367,7 @@ def _load_sidecar(path: str) -> tuple[dict, Fingerprint]:
         raise RunFormatError(
             f"run sidecar {sc_path!r}: malformed fingerprint: {e}"
         ) from None
+    _check_format_version(int(sc.get("format_version", 0)), sc_path)
     return sc, fp
 
 
@@ -260,6 +396,7 @@ def open_run(path: str) -> RunInfo:
     if head[:8] != kio.BIN_MAGIC:
         raise RunFormatError(f"run file {path!r} is not SORTBIN1-framed")
     kio._check_bin_header(head, path, dtype)
+    _check_format_version(head[BIN_VERSION_OFF], path)
     width = int(sc.get("payload_width", 0))
     disk = st.st_size
     if width:
@@ -280,6 +417,7 @@ def open_run(path: str) -> RunInfo:
                 int.from_bytes(phead[8:12], "little") != width:
             raise RunFormatError(
                 f"run payload {pp!r}: bad SORTPAY1 header")
+        _check_format_version(phead[PAY_VERSION_OFF], pp)
         disk += pst.st_size
     return RunInfo(path, n, dtype, width, fp, disk)
 
@@ -289,16 +427,29 @@ def read_run_chunks(info: RunInfo, chunk_elems: int):
     order — keys as zero-copy mmap slices (``kio.open_keys_mmap``, the
     PR 2 page-in path), payload as mmap-backed ``(m, width)`` views.
     Bounded memory at any run size."""
-    mm = kio.open_keys_mmap(info.path, info.dtype)
+    try:
+        mm = kio.open_keys_mmap(info.path, info.dtype)
+    except ValueError as e:
+        # a torn tail leaves a byte count that is not a whole number of
+        # keys — np.memmap raises a bare ValueError; type it so the
+        # merge blame ladder can re-spill this run
+        raise RunFormatError(
+            f"run file {info.path!r}: torn/unmappable keys body "
+            f"({e})") from None
     if int(mm.size) != info.n:
         raise RunFormatError(
             f"run file {info.path!r}: {int(mm.size)} keys on disk, "
             f"sidecar says {info.n}")
     pm = None
     if info.payload_width:
-        pm = np.memmap(info.pay_path, dtype=np.uint8, mode="r",
-                       offset=PAY_HEADER_LEN)
-        pm = pm.reshape(info.n, info.payload_width)
+        try:
+            pm = np.memmap(info.pay_path, dtype=np.uint8, mode="r",
+                           offset=PAY_HEADER_LEN)
+            pm = pm.reshape(info.n, info.payload_width)
+        except ValueError as e:
+            raise RunFormatError(
+                f"run payload {info.pay_path!r}: torn/unmappable body "
+                f"({e})") from None
     if info.n == 0:
         return
     chunk_elems = max(1, int(chunk_elems))
@@ -389,6 +540,17 @@ def remove_run(info: RunInfo) -> None:
     — the external driver's cleanup: partition and intermediate runs
     are dataset-sized and must not outlive the sort that made them."""
     for p in (info.path, info.pay_path, info.sidecar_path):
+        try:
+            os.unlink(p)
+        except OSError:
+            pass
+
+
+def remove_run_paths(path: str) -> None:
+    """Best-effort deletion by the KEY path alone — cleanup of a run
+    whose metadata never loaded (a torn/damaged resume candidate the
+    manifest names but :func:`open_run` rejects)."""
+    for p in (path, path + ".pay", path + ".fpr.json"):
         try:
             os.unlink(p)
         except OSError:

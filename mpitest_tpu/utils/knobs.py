@@ -553,6 +553,11 @@ register("SORT_FAULT_STALL_MS", "int", 250, "an integer >= 1",
          "Milliseconds the dispatch_stall fault site blocks the "
          "dispatch thread (the chaos drill behind the watchdog gate).",
          _int("SORT_FAULT_STALL_MS", lo=1))
+register("SORT_FAULT_ENOSPC_AT", "int", 1, "an integer >= 1",
+         "Which spill write (1-based, counted per registry) the armed "
+         "spill_enospc fault site fails with ENOSPC — deterministic "
+         "mid-merge disk-full drills.",
+         _int("SORT_FAULT_ENOSPC_AT", lo=1))
 
 # Sort-as-a-service knobs (ISSUE 8: mpitest_tpu/serve/ + the
 # drivers/sort_server.py entry point).  All validated fail-fast at
@@ -710,6 +715,23 @@ register("SORT_SERVE_SPILL", "enum", "auto", "auto | off",
          "Route serve requests larger than SORT_SERVE_MAX_BYTES to the "
          "out-of-core spill tier instead of a typed 'bytes' rejection.",
          _enum("SORT_SERVE_SPILL", ("auto", "off")))
+
+# Crash-durable external sort (ISSUE 18: store/manifest.py) — journaled
+# spill manifests, kill-resume at the merge phase, and the age-gated
+# orphan GC sweep.
+
+register("SORT_RESUME", "enum", "auto", "auto | off",
+         "Crash resume of dataset-keyed external sorts: 'auto' "
+         "durably journals every committed spill run in a manifest "
+         "and a retried/restarted sort of the same dataset id replays "
+         "it, re-validates the runs and re-enters at the merge phase; "
+         "'off' disables journaling and resume entirely.",
+         _enum("SORT_RESUME", ("auto", "off")))
+register("SORT_SPILL_GC_AGE_S", "int", 3600, "an integer >= 0",
+         "Minimum age in seconds before an orphaned spill file (one "
+         "no live manifest references) is reclaimed by the startup GC "
+         "sweep — a concurrent sort's fresh files are never swept.",
+         _int("SORT_SPILL_GC_AGE_S", lo=0))
 
 # Streaming-sentinel knobs (ISSUE 16: serve/sentinel.py) — live anomaly
 # detection over the span stream; alerts ride registered serve.alert

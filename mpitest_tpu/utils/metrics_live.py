@@ -182,6 +182,17 @@ METRICS: dict[str, tuple[str, str]] = {
         "counter", "Serve requests routed to the out-of-core spill "
                    "tier (payload larger than the admission byte "
                    "bound)."),
+    # crash-durable spill tier (ISSUE 18): manifest-replay resumes and
+    # the startup orphan sweep, fed from external.resume / external.gc
+    # span closes.
+    "sort_external_resumes_total": (
+        "counter", "External sorts that replayed a journaled spill "
+                   "manifest and re-entered at the merge phase "
+                   "(kill -9 / retried-request recovery)."),
+    "sort_external_orphans_reclaimed_total": (
+        "counter", "Orphaned spill files reclaimed by the age-gated "
+                   "startup GC sweep (files no live manifest "
+                   "references)."),
 }
 
 _HISTOGRAM_BUCKETS: dict[str, tuple[float, ...]] = {
@@ -517,6 +528,12 @@ class SpanMetricsBridge:
                 "sort_external_merge_seconds_total").inc(dt)
         elif name == "external.recover":
             metrics.counter("sort_external_recoveries_total").inc(1)
+        elif name == "external.resume":
+            metrics.counter("sort_external_resumes_total").inc(1)
+        elif name == "external.gc":
+            metrics.counter(
+                "sort_external_orphans_reclaimed_total").inc(
+                float(attrs.get("reclaimed", 0) or 0))
         elif name == "serve.deadline":
             metrics.counter("sort_serve_deadline_exceeded_total").inc(
                 1, stage=str(attrs.get("stage", "?")))

@@ -111,6 +111,14 @@ SPAN_NAMES: dict[str, str] = {
     "external.recover": ("external-sort integrity recovery point event "
                          "(reason, bad_runs, attempt) — blamed runs "
                          "re-spilled from source before the re-merge"),
+    # crash-durable spill tier (ISSUE 18, store/manifest.py)
+    "external.resume": ("one spill-manifest replay (dataset, "
+                        "committed, valid, skipped_lines) — committed "
+                        "runs re-validated and re-entered at the merge "
+                        "phase instead of being re-sorted"),
+    "external.gc": ("one orphaned-spill sweep (dir, reclaimed, bytes, "
+                    "age_s) — files no live manifest references, "
+                    "reclaimed age-gated at startup"),
     # models/ingest.py — streamed pipeline stages (ISSUE 2)
     "ingest.parse": "parse/materialize one host chunk",
     "ingest.encode": "codec-encode one chunk (worker pool)",
@@ -158,6 +166,10 @@ PLAN_SPAN = "sort.plan"
 EXTERNAL_RUN_SPAN = "external.run"
 EXTERNAL_MERGE_SPAN = "external.merge"
 EXTERNAL_RECOVER_SPAN = "external.recover"
+
+#: Crash-durable spill tier names (ISSUE 18).
+EXTERNAL_RESUME_SPAN = "external.resume"
+EXTERNAL_GC_SPAN = "external.gc"
 
 #: Request-trace attributes (ISSUE 10): the wire layer mints one
 #: ``trace_id`` per request (echoed in the response) and the dispatch

@@ -238,6 +238,29 @@ def test_rule_breaker_flap():
     assert any("recovered" in c for c in f.evidence)
 
 
+def test_rule_spill_churn():
+    # one recovery alone is normal operation — below the gate
+    rows = [{"name": "external.recover",
+             "attrs": {"reason": "fingerprint", "bad_runs": 1}}]
+    assert doctor.diagnose(doctor.evidence_from_rows(rows)) == []
+    # recovery + crash resume in one trace = churn (warn)
+    rows.append({"name": "external.resume",
+                 "attrs": {"dataset": "ds1", "committed": 4,
+                           "valid": 4}})
+    f = _only(doctor.diagnose(doctor.evidence_from_rows(rows)),
+              "spill_churn")
+    assert f.severity == "warn" and f.value == 2.0
+    assert f.knob == "SORT_SPILL_DIR"
+    assert any("external.recover" in c for c in f.evidence)
+    assert any("external.resume" in c for c in f.evidence)
+    # repeated integrity recoveries escalate to critical
+    rows = [{"name": "external.recover",
+             "attrs": {"reason": "fingerprint", "bad_runs": 1}}] * 2
+    f = _only(doctor.diagnose(doctor.evidence_from_rows(rows)),
+              "spill_churn")
+    assert f.severity == "critical"
+
+
 def test_rule_deadline_burn():
     rows = ([{"name": "serve.request", "dt": 0.01,
               "attrs": {"status": "ok"}}] * 12

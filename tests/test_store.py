@@ -357,6 +357,194 @@ def test_wire_bad_payload_bytes_is_typed(rng):
         core.drain_and_stop(timeout=10.0)
 
 
+# ---------------------------------------------------- crash durability
+
+def _plant_crash_state(spill_dir, x, budget, dataset, chunks):
+    """Simulate a process killed mid-external-sort: the chunk indices
+    in ``chunks`` durably committed + journaled (exactly the on-disk
+    state the WAL discipline guarantees), everything else absent.
+    Returns ``(chunk_elems, manifest_writer_path, run_infos)``."""
+    from mpitest_tpu.store import manifest as mfstlib
+
+    chunk = external.spill_chunk_elems(budget, x.dtype, 0)
+    mw = mfstlib.ManifestWriter(str(spill_dir), dataset,
+                                dtype=x.dtype.name, n=int(x.size),
+                                payload_width=0, algorithm="auto",
+                                chunk_elems=chunk, budget=budget,
+                                fanin=16)
+    infos = {}
+    for ci in chunks:
+        piece = np.sort(x[ci * chunk:(ci + 1) * chunk])
+        infos[ci] = runlib.write_run(str(spill_dir), f"rdead_{ci:05d}",
+                                     piece, durable=True)
+        mw.commit_run(ci, infos[ci])
+    mw.close()   # close the handle, NOT delete — the crash shape
+    return chunk, mw.path, infos
+
+
+def _external_span_counts(tracer):
+    out = {}
+    for line in tracer.spans.to_jsonl().splitlines():
+        name = json.loads(line).get("name", "")
+        if name.startswith("external."):
+            out[name] = out.get(name, 0) + 1
+    return out
+
+
+@pytest.mark.parametrize("state", ("empty", "partial_line",
+                                   "all_committed", "torn_run",
+                                   "bitrot_run"))
+def test_crash_grid_resumes_bit_identical(tmp_path, rng, state):
+    """ISSUE 18 simulated-crash grid: each manifest state resumes (or
+    degrades to a cold sort) with output bit-identical to an
+    uninterrupted sort — and an all-committed journal re-enters at the
+    merge phase with ZERO re-sorted chunks."""
+    from mpitest_tpu.utils.trace import Tracer
+
+    budget = 1 << 15
+    x = _keys(rng, np.int32, 30_000)
+    chunk = external.spill_chunk_elems(budget, x.dtype, 0)
+    nchunks = -(-x.size // chunk)
+    assert nchunks >= 3, "grid needs a multi-run sort"
+    committed = {"empty": [], "partial_line": [0],
+                 "all_committed": list(range(nchunks)),
+                 "torn_run": list(range(nchunks)),
+                 "bitrot_run": list(range(nchunks))}[state]
+    _, mpath, infos = _plant_crash_state(tmp_path, x, budget, "ds1",
+                                         committed)
+    if state == "partial_line":
+        with open(mpath, "ab") as f:   # torn tail: half a journal line
+            f.write(b'{"v": "sortmfst1", "kind": "run", "chu')
+    elif state == "torn_run":
+        os.truncate(infos[1].path, os.path.getsize(infos[1].path) - 5)
+    elif state == "bitrot_run":
+        with open(infos[1].path, "r+b") as f:
+            f.seek(40)
+            b = f.read(1)
+            f.seek(40)
+            f.write(bytes([b[0] ^ 0x5A]))
+    tr = Tracer()
+    res = external.external_sort(x, budget=budget,
+                                 spill_dir=str(tmp_path),
+                                 dataset="ds1", tracer=tr)
+    assert np.array_equal(res.keys, np.sort(x))
+    spans = _external_span_counts(tr)
+    expect_resumed = {"empty": 0, "partial_line": 1,
+                      "all_committed": nchunks,
+                      "torn_run": nchunks - 1,
+                      "bitrot_run": nchunks - 1}[state]
+    assert res.resumed_runs == expect_resumed
+    # resumed chunks were NOT re-sorted; damaged/missing ones were
+    assert spans.get("external.run", 0) == nchunks - expect_resumed
+    if expect_resumed:
+        assert spans.get("external.resume") == 1
+    # success retires the journal — nothing left to GC
+    assert not os.path.exists(mpath)
+    left = [f for f in os.listdir(tmp_path)
+            if f.endswith((".run", ".pay", ".fpr.json", ".tmp"))]
+    assert left == []
+
+
+def test_crash_grid_stale_format_version_is_typed(tmp_path, rng):
+    from mpitest_tpu.store import manifest as mfstlib
+
+    x = _keys(rng, np.int32, 20_000)
+    mp = mfstlib.manifest_path(str(tmp_path), "ds9")
+    begin = {"v": mfstlib.MANIFEST_SCHEMA, "kind": "begin",
+             "dataset": "ds9", "dtype": "int32", "n": int(x.size),
+             "payload_width": 0, "format_version": 99,
+             "chunk_elems": 8192, "algorithm": "auto",
+             "budget": 1 << 15, "fanin": 16}
+    with open(mp, "w") as f:
+        f.write(json.dumps(begin) + "\n")
+    with pytest.raises(runlib.RunVersionError, match="format_version 99"):
+        external.external_sort(x, budget=1 << 15,
+                               spill_dir=str(tmp_path), dataset="ds9")
+    # RunVersionError IS a RunFormatError — one except clause catches
+    # both disk damage and version skew, but they stay distinguishable
+    assert issubclass(runlib.RunVersionError, runlib.RunFormatError)
+
+
+def test_resume_off_knob_disables_journaling(tmp_path, rng):
+    from mpitest_tpu.store import manifest as mfstlib
+
+    x = _keys(rng, np.int32, 20_000)
+    with knobs.scoped_env(SORT_RESUME="off"):
+        res = external.external_sort(x, budget=1 << 15,
+                                     spill_dir=str(tmp_path),
+                                     dataset="ds1")
+    assert np.array_equal(res.keys, np.sort(x))
+    assert res.resumed_runs == 0
+    assert mfstlib.live_manifests(str(tmp_path)) == []
+
+
+def test_stale_run_version_discarded_on_resume(tmp_path, rng):
+    """A journaled run whose FILE carries an unknown format_version is
+    a typed error at open — the resume path must surface it, not
+    silently re-sort around a build-skew problem."""
+    x = _keys(rng, np.int32, 30_000)
+    budget = 1 << 15
+    _, mpath, infos = _plant_crash_state(tmp_path, x, budget, "ds1", [0])
+    # stamp an unknown version into the run's SORTBIN1 header
+    with open(infos[0].path, "r+b") as f:
+        f.seek(runlib.BIN_VERSION_OFF)
+        f.write(bytes([99]))
+    with pytest.raises(runlib.RunVersionError):
+        external.external_sort(x, budget=budget,
+                               spill_dir=str(tmp_path), dataset="ds1")
+
+
+def test_mid_merge_enospc_is_typed_and_partials_deleted(tmp_path, rng):
+    from mpitest_tpu import faults
+
+    x = _keys(rng, np.int32, 30_000)
+    # fire at the 3rd spill write: the partition phase survives the
+    # first writes, then the disk "fills"
+    with knobs.scoped_env(SORT_FAULT_ENOSPC_AT="3"):
+        reg = faults.FaultRegistry("spill_enospc", seed=3)
+        faults.install(reg)
+        try:
+            with pytest.raises(external.SpillCapacityError) as ei:
+                external.external_sort(x, budget=1 << 15,
+                                       spill_dir=str(tmp_path),
+                                       dataset="ds1")
+        finally:
+            faults.install(None)
+    import errno as errno_mod
+    assert ei.value.errno == errno_mod.ENOSPC
+    assert isinstance(ei.value, OSError)
+    # every partial (runs, tmp files, the journal) deleted
+    assert [f for f in os.listdir(tmp_path)] == []
+
+
+def test_gc_reclaims_orphans_age_gated(tmp_path, rng):
+    import time as time_mod
+
+    from mpitest_tpu.store import manifest as mfstlib
+
+    keys = np.sort(_keys(rng, np.int32, 1000))
+    orphan = runlib.write_run(str(tmp_path), "orphan_00000", keys)
+    live = runlib.write_run(str(tmp_path), "live_00000", keys,
+                            durable=True)
+    mw = mfstlib.ManifestWriter(str(tmp_path), "liveds", dtype="int32",
+                                n=1000, payload_width=0,
+                                algorithm="auto", chunk_elems=8192,
+                                budget=1 << 15, fanin=16)
+    mw.commit_run(0, live)
+    mw.close()
+    (tmp_path / "stray.run.tmp").write_bytes(b"x")
+    # age gate: fresh files are never swept (a concurrent sort's)
+    assert external.gc_spill_dir(str(tmp_path), age_s=3600) == 0
+    old = time_mod.time() - 7200
+    for fn in os.listdir(tmp_path):
+        os.utime(tmp_path / fn, (old, old))
+    assert external.gc_spill_dir(str(tmp_path), age_s=3600) == 3
+    left = sorted(os.listdir(tmp_path))
+    # manifest-referenced files and the journal survive; orphans die
+    assert "live_00000.run" in left and "liveds.mfst" in left
+    assert not any(f.startswith(("orphan", "stray")) for f in left)
+
+
 # --------------------------------------------------------------- knobs
 
 def test_external_knob_validation():
@@ -371,3 +559,15 @@ def test_external_knob_validation():
             knobs.get("SORT_SERVE_SPILL")
     assert knobs.get("SORT_MERGE_FANIN") == 16
     assert knobs.get("SORT_SERVE_SPILL") == "auto"
+    # ISSUE 18 durability knobs
+    with knobs.scoped_env(SORT_RESUME="maybe"):
+        with pytest.raises(ValueError, match="SORT_RESUME"):
+            knobs.get("SORT_RESUME")
+    with knobs.scoped_env(SORT_SPILL_GC_AGE_S="-1"):
+        with pytest.raises(ValueError, match="SORT_SPILL_GC_AGE_S"):
+            knobs.get("SORT_SPILL_GC_AGE_S")
+    with knobs.scoped_env(SORT_FAULT_ENOSPC_AT="0"):
+        with pytest.raises(ValueError, match="SORT_FAULT_ENOSPC_AT"):
+            knobs.get("SORT_FAULT_ENOSPC_AT")
+    assert knobs.get("SORT_RESUME") == "auto"
+    assert knobs.get("SORT_SPILL_GC_AGE_S") == 3600

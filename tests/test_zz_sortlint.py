@@ -177,7 +177,8 @@ def test_doctor_registry_vocabulary():
     assert {"skew_imbalance", "cap_thrash", "compile_storm",
             "window_misfit", "spill_bound",
             "verify_overhead_regression", "breaker_flap",
-            "deadline_burn", "local_sort_lax"} == set(doctor_mod.DOCTOR_RULES)
+            "deadline_burn", "local_sort_lax",
+            "spill_churn"} == set(doctor_mod.DOCTOR_RULES)
     # every vocabulary key has a registered diagnosis function
     assert set(doctor_mod.DOCTOR_RULES) == set(doctor_mod._RULES)
     assert all(s in doctor_mod.SEVERITIES
@@ -292,6 +293,47 @@ def test_sl014_spill_file_fence():
     # unrelated open() stays legal everywhere
     ok = 'def f() -> None:\n    open("/tmp/keys.bin", "rb")\n'
     assert lint_source(ok, "mpitest_tpu/serve/x.py") == []
+
+
+def test_sl014_manifest_journal_fence():
+    """ISSUE 18: spill-manifest journals (.mfst) are fenced into
+    store/manifest.py — the commit protocol (atomic begin, fsync'd
+    appends, torn-tail replay) lives there, and runs.py is NOT a valid
+    home for them either."""
+    lit = 'def f() -> None:\n    open("/spill/ds1.mfst", "ab")\n'
+    assert rules_of(lint_source(lit, "mpitest_tpu/serve/x.py")) == \
+        ["SL014"]
+    # runs.py is the RUN home, not the manifest home
+    assert rules_of(lint_source(lit, "mpitest_tpu/store/runs.py")) == \
+        ["SL014"]
+    # the manifest home is exempt for .mfst ...
+    assert lint_source(lit, "mpitest_tpu/store/manifest.py") == []
+    # ... but not for run files
+    run_open = 'def f() -> None:\n    open("/spill/r0.run", "rb")\n'
+    assert rules_of(lint_source(
+        run_open, "mpitest_tpu/store/manifest.py")) == ["SL014"]
+
+
+def test_sl014_spill_rename_needs_replace():
+    """ISSUE 18: publishing a spill artifact with os.rename (instead
+    of os.replace) is a finding ANYWHERE, home modules included — the
+    durable-commit protocol is replace + fsync(dir)."""
+    bad = ('import os\n'
+           'def f(d: str) -> None:\n'
+           '    os.rename(f"{d}/r0.run.tmp", f"{d}/r0.run")\n')
+    assert rules_of(lint_source(bad, "mpitest_tpu/store/runs.py")) == \
+        ["SL014"]
+    bad_m = ('import os\n'
+             'def f(d: str) -> None:\n'
+             '    os.rename(f"{d}/a.mfst.tmp", f"{d}/a.mfst")\n')
+    assert rules_of(lint_source(
+        bad_m, "mpitest_tpu/store/manifest.py")) == ["SL014"]
+    # os.replace is the blessed publish; non-spill renames stay legal
+    ok = ('import os\n'
+          'def f(d: str) -> None:\n'
+          '    os.replace(f"{d}/r0.run.tmp", f"{d}/r0.run")\n'
+          '    os.rename(f"{d}/log.txt", f"{d}/log.old")\n')
+    assert lint_source(ok, "mpitest_tpu/store/runs.py") == []
 
 
 def test_sl040_typed_core_annotations():

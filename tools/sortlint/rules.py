@@ -704,9 +704,16 @@ register(Rule(
 #: loud instead of silently wrong.
 _RUN_FILE_HOME = "mpitest_tpu/store/runs.py"
 
+#: The ONE module allowed to open spill-manifest journals (ISSUE 18):
+#: the journal's commit protocol (atomic begin, fsync'd appends,
+#: torn-tail replay) lives in store/manifest.py — ad-hoc ``.mfst``
+#: writes elsewhere would break the crash-resume guarantee silently.
+_MANIFEST_HOME = "mpitest_tpu/store/manifest.py"
+
 #: File-name suffixes that identify a spill artifact (the run format's
-#: whole on-disk surface: keys, payload, sidecar, wire staging).
-_RUN_SUFFIXES = (".run", ".pay", ".fpr.json", ".spill")
+#: whole on-disk surface: keys, payload, sidecar, wire staging, and
+#: the ISSUE 18 manifest journal).
+_RUN_SUFFIXES = (".run", ".pay", ".fpr.json", ".spill", ".mfst")
 
 #: RunInfo path accessors — passing one to open()/np.memmap is the
 #: other ad-hoc bypass shape.
@@ -715,47 +722,92 @@ _RUN_PATH_ATTRS = ("pay_path", "sidecar_path")
 _OPENERS = ("open", "memmap")
 
 
-def _spill_literalish(node: ast.AST) -> bool:
-    """True when an argument expression names a spill artifact: a
-    string constant (or f-string tail) ending in a run suffix, or a
-    RunInfo path accessor."""
+def _spill_suffix(node: ast.AST) -> str | None:
+    """The run-suffix an argument expression names, or None: a string
+    constant (or f-string tail) ending in a run suffix, or a RunInfo
+    path accessor (reported as ``.run``-family)."""
+    text = None
     if isinstance(node, ast.Constant) and isinstance(node.value, str):
-        return node.value.endswith(_RUN_SUFFIXES)
-    if isinstance(node, ast.JoinedStr) and node.values:
+        text = node.value
+    elif isinstance(node, ast.JoinedStr) and node.values:
         last = node.values[-1]
         if isinstance(last, ast.Constant) and isinstance(last.value, str):
-            return last.value.endswith(_RUN_SUFFIXES)
+            text = last.value
+    if text is not None:
+        for suf in _RUN_SUFFIXES:
+            if text.endswith(suf):
+                return suf
     if isinstance(node, ast.Attribute) and node.attr in _RUN_PATH_ATTRS:
-        return True
-    return False
+        return ".run"
+    return None
+
+
+def _spill_literalish(node: ast.AST) -> bool:
+    """True when an argument expression names a spill artifact."""
+    return _spill_suffix(node) is not None
 
 
 def _check_run_file_fence(path: str, src: str,
                           tree: ast.AST) -> list[Finding]:
     p = path.replace("\\", "/")
-    if p.endswith(_RUN_FILE_HOME):
-        return []
+    in_runs_home = p.endswith(_RUN_FILE_HOME)
+    in_manifest_home = p.endswith(_MANIFEST_HOME)
     out = []
     for node, _stk in _walk(tree):
         if not isinstance(node, ast.Call):
             continue
-        if _attr_chain(node.func).split(".")[-1] not in _OPENERS:
+        chain = _attr_chain(node.func)
+        leaf = chain.split(".")[-1]
+        # os.rename of a spill artifact is a finding ANYWHERE (both
+        # homes included): a non-atomic publish loses the all-or-
+        # nothing crash guarantee — spill artifacts commit via
+        # os.replace + fsync(dir) (ISSUE 18 durable-commit protocol)
+        if chain in ("os.rename", "rename") and leaf == "rename":
+            if any(_spill_literalish(a) for a in node.args):
+                out.append(Finding(
+                    "SL014", path, node.lineno,
+                    "os.rename of a spill artifact — spill files "
+                    "commit via os.replace (+ fsync of the directory) "
+                    "so a crash leaves them fully present or absent, "
+                    "never half-published"))
             continue
-        if any(_spill_literalish(a) for a in node.args):
-            out.append(Finding(
-                "SL014", path, node.lineno,
-                "ad-hoc open()/memmap of a spill-run artifact "
-                "(.run/.pay/.fpr.json/.spill) outside store/runs.py — "
-                "run files carry SORTBIN1 framing + a fingerprint "
-                "sidecar; go through store.runs (write_run/open_run/"
-                "read_run_chunks/run_body_views) so a bad file stays "
-                "a typed, loud error"))
+        if leaf not in _OPENERS:
+            continue
+        for a in node.args:
+            suf = _spill_suffix(a)
+            if suf is None:
+                continue
+            if suf == ".mfst":
+                if in_manifest_home:
+                    continue
+                out.append(Finding(
+                    "SL014", path, node.lineno,
+                    "ad-hoc open of a spill-manifest journal (.mfst) "
+                    "outside store/manifest.py — the journal's commit "
+                    "protocol (atomic begin, fsync'd appends, "
+                    "torn-tail replay) lives there; go through "
+                    "store.manifest (load/live_manifests/"
+                    "ManifestWriter) so crash resume stays sound"))
+            else:
+                if in_runs_home:
+                    continue
+                out.append(Finding(
+                    "SL014", path, node.lineno,
+                    "ad-hoc open()/memmap of a spill-run artifact "
+                    "(.run/.pay/.fpr.json/.spill) outside "
+                    "store/runs.py — run files carry SORTBIN1 framing "
+                    "+ a fingerprint sidecar; go through store.runs "
+                    "(write_run/open_run/read_run_chunks/"
+                    "run_body_views) so a bad file stays a typed, "
+                    "loud error"))
+            break
     return out
 
 
 register(Rule(
     "SL014", "spill-file-fence",
-    "spill-run files are read/written only via mpitest_tpu/store/runs.py",
+    "spill-run files only via store/runs.py, manifest journals only "
+    "via store/manifest.py, publishes via os.replace (never os.rename)",
     _check_run_file_fence))
 
 

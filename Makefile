@@ -96,7 +96,9 @@
 #                  prediction honest (lying profiles stamp regret).
 #   make lint    — static analysis (ISSUE 4): sortlint (the project's
 #                  custom AST rules — env-knob registry, span schema,
-#                  SPMD safety, fault coverage, typed core), the
+#                  SPMD safety, fault coverage, typed core), threadlint
+#                  (ISSUE 19: interprocedural concurrency analysis over
+#                  the registered thread roots and lock ranks), the
 #                  cross-backend comm parity checker, a
 #                  -Wconversion/-Wshadow -Werror pass over every C
 #                  source, and mypy strict on the typed core / a
@@ -121,8 +123,8 @@ PYTHON ?= python3
     ingest-selftest fault-selftest multichip-selftest serve-selftest \
     chaos-serve-selftest planner-selftest external-selftest \
     durability-selftest doctor-selftest localsort-selftest lint \
-    cwarn-check typecheck tidy-check knob-docs sanitize-selftest \
-    bench-history clean
+    threadlint-fixtures cwarn-check typecheck tidy-check knob-docs \
+    sanitize-selftest bench-history clean
 
 chip-test:
 	$(PYTHON) -u bench/chip_regression.py
@@ -361,14 +363,23 @@ ingest-selftest: native-encode
 	    $(INGEST_TMP)/trace.jsonl $(INGEST_TMP)/metrics.jsonl
 
 # ---------------------------------------------------------------- lint
-# The static-analysis gate (ISSUE 4).  Always-on legs: sortlint, the
-# comm parity checker, and the C warning gate (gcc is in every image).
-# mypy / clang-tidy legs run when installed and report a loud SKIP
-# otherwise — never a silent pass of a gate that did not run.
+# The static-analysis gate (ISSUE 4).  Always-on legs: sortlint,
+# threadlint (ISSUE 19: interprocedural concurrency analysis — JAX
+# fence, lock order, blocking-under-lock, shared-write locksets, GIL
+# wedge), the comm parity checker, and the C warning gate (gcc is in
+# every image).  mypy / clang-tidy legs run when installed and report
+# a loud SKIP otherwise — never a silent pass of a gate that did not
+# run.
 lint: cwarn-check
 	$(PYTHON) -m tools.sortlint
+	$(PYTHON) -m tools.threadlint
 	$(PYTHON) tools/comm_parity.py
 	$(MAKE) typecheck tidy-check
+
+#: Fixture drift gate: every threadlint rule must still FIRE on its
+#: planted bad fixture — a silently-dead rule is worse than no rule.
+threadlint-fixtures:
+	$(PYTHON) -m tools.threadlint --selftest
 
 #: Every C source must compile warning-free under the strict set.  The
 #: two MPI-linked files typecheck against the vendored stub header.

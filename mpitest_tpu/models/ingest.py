@@ -402,7 +402,9 @@ def stream_to_mesh(x: np.ndarray, mesh: "Mesh",
             spans.record("ingest.transfer", t0, dt, **attrs)
 
     q: queue.Queue = queue.Queue(maxsize=2)
-    producer = threading.Thread(target=parse_chunks, args=(q,), daemon=True)
+    # threadlint TL010: named like its registered root (ingest-parse)
+    producer = threading.Thread(target=parse_chunks, args=(q,),
+                                name="ingest-parse", daemon=True)
     producer.start()
     enc_pool = ThreadPoolExecutor(threads, thread_name_prefix="ingest-enc")
     xfer_pool = ThreadPoolExecutor(1, thread_name_prefix="ingest-xfer")

@@ -96,25 +96,33 @@ class ExecutorCache:
             exe = self._entries.get(key)
             if exe is not None:
                 self.stats.hits += 1
-                self._event(hit=True, bucket=bucket, dtype=dtype_name)
-                return exe
-            # compile under the lock: two threads racing on a cold key
-            # would otherwise both pay the compile (the dispatch thread
-            # is single today, but the contract shouldn't depend on it)
-            t0 = time.perf_counter()
-            exe = compile_packed_sort(n_words_total, bucket)
-            dt = time.perf_counter() - t0
-            self._entries[key] = exe
-            self.stats.misses += 1
-            self.stats.compile_s += dt
-            self.stats.buckets.add(bucket)
+                dt = None
+            else:
+                # compile under the lock: two threads racing on a cold
+                # key would otherwise both pay the compile (the dispatch
+                # thread is single today, but the contract shouldn't
+                # depend on it)
+                t0 = time.perf_counter()
+                # threadlint: disable=TL003 -- cold-key dogpile guard, reviewed hold
+                exe = compile_packed_sort(n_words_total, bucket)
+                dt = time.perf_counter() - t0
+                self._entries[key] = exe
+                self.stats.misses += 1
+                self.stats.compile_s += dt
+                self.stats.buckets.add(bucket)
+        # threadlint TL002: span observers (metrics bridge, sentinel)
+        # run on the EMITTING thread — never emit while holding the
+        # cache lock, or the sentinel's lock nests under it
+        if dt is None:
+            self._event(hit=True, bucket=bucket, dtype=dtype_name)
+        else:
             # ISSUE 10: stamp the miss event with the XLA cost analysis
             # (flops / bytes accessed / generated code size) so compile
             # cost AND program cost are attributable per shape bucket
             # straight from the span stream.
             self._event(hit=False, bucket=bucket, dtype=dtype_name,
                         compile_s=round(dt, 6), **executable_stats(exe))
-            return exe
+        return exe
 
     def missing_packed(self, buckets: "tuple[int, ...]",
                        dtype_names: "tuple[str, ...]",
@@ -150,10 +158,14 @@ class ExecutorCache:
         never waits on prewarm."""
         key = ("packed", bucket, dtype_name, n_words_total)
         with self._lock:
-            if key in self._entries:
+            hit = key in self._entries
+            if hit:
                 self.stats.hits += 1
-                self._event(hit=True, bucket=bucket, dtype=dtype_name)
-                return
+        if hit:
+            # threadlint TL002: emit outside the cache lock (observers
+            # run on this thread and take their own locks)
+            self._event(hit=True, bucket=bucket, dtype=dtype_name)
+            return
         t0 = time.perf_counter()
         exe = compile_packed_sort(n_words_total, bucket)
         dt = time.perf_counter() - t0
@@ -198,7 +210,10 @@ class ExecutorCache:
             for b in buckets:
                 self._build_detached(b, dtype_name, 1 + n_words)
                 built += 1
-        self.stats.prewarmed += built
+        # threadlint TL004: prewarm runs on the main thread AND the
+        # tuner's background prewarm thread — count under the lock
+        with self._lock:
+            self.stats.prewarmed += built
         log(f"prewarmed {built} executable(s) "
             f"(buckets {sorted(self.stats.buckets)})")
         return built

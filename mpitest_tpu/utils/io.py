@@ -252,7 +252,9 @@ def _iter_text_key_chunks(path: str, dt: np.dtype, chunk_elems: int,
     threads = threads or ingest_threads()
     eng = native_encode.engine()  # resolved ONCE per file, not per block
     blocks = _iter_text_blocks(path, chunk_elems * _TEXT_BYTES_PER_KEY)
-    with ThreadPoolExecutor(max_workers=threads) as ex:
+    # threadlint TL010: pool threads must be attributable in stacks
+    with ThreadPoolExecutor(max_workers=threads,
+                            thread_name_prefix="io-parse") as ex:
         pending = deque()
         for b in blocks:
             pending.append(ex.submit(_parse_text_block, b, dt, eng))

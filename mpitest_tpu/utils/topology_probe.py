@@ -29,9 +29,15 @@ from __future__ import annotations
 
 import subprocess
 import sys
+import threading
 
 #: Bounded connect-probe budget (seconds) — see module docstring.
 PROBE_TIMEOUT_S = 45.0
+
+#: Serializes the probe and guards ``_verdict`` — threadlint TL004:
+#: the verdict is written from the startup prewarm (main thread) AND
+#: the tuner's background prewarm thread.
+_PROBE_LOCK = threading.Lock()
 
 #: Topology the throwaway fetch asks for; any valid name works (the
 #: probe tests reachability, not the shape).
@@ -49,29 +55,36 @@ def probe_tpu_compiler(timeout_s: float = PROBE_TIMEOUT_S) -> str:
     process; the verdict is cached (call :func:`reset_cache` to force a
     re-probe)."""
     global _verdict
-    if _verdict is not None:
+    with _PROBE_LOCK:
+        if _verdict is not None:
+            return _verdict
+        code = ("from jax.experimental import topologies; "
+                "topologies.get_topology_desc(platform='tpu', "
+                f"topology_name='{_PROBE_TOPOLOGY}')")
+        try:
+            # serializing concurrent probes under the lock is the point
+            # (one child process, one cached verdict for everyone), and
+            # the child is timeout-bounded so the lock hold is too
+            # threadlint: disable=TL003 -- bounded one-shot probe, held deliberately
+            r = subprocess.run([sys.executable, "-c", code],
+                               capture_output=True, text=True,
+                               timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            _verdict = (f"TPU topology probe timed out after "
+                        f"{timeout_s:.0f}s (compiler tunnel "
+                        "unreachable); AOT compiles skipped, not wedged")
+            return _verdict
+        if r.returncode != 0:
+            tail = (r.stderr.strip().splitlines()
+                    or ["no error output"])[-1]
+            _verdict = f"TPU topology AOT unavailable: {tail[:200]}"
+            return _verdict
+        _verdict = ""
         return _verdict
-    code = ("from jax.experimental import topologies; "
-            "topologies.get_topology_desc(platform='tpu', "
-            f"topology_name='{_PROBE_TOPOLOGY}')")
-    try:
-        r = subprocess.run([sys.executable, "-c", code],
-                           capture_output=True, text=True,
-                           timeout=timeout_s)
-    except subprocess.TimeoutExpired:
-        _verdict = (f"TPU topology probe timed out after "
-                    f"{timeout_s:.0f}s (compiler tunnel unreachable); "
-                    "AOT compiles skipped, not wedged")
-        return _verdict
-    if r.returncode != 0:
-        tail = (r.stderr.strip().splitlines() or ["no error output"])[-1]
-        _verdict = f"TPU topology AOT unavailable: {tail[:200]}"
-        return _verdict
-    _verdict = ""
-    return _verdict
 
 
 def reset_cache() -> None:
     """Drop the cached verdict (tests)."""
     global _verdict
-    _verdict = None
+    with _PROBE_LOCK:
+        _verdict = None

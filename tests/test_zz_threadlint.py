@@ -1,0 +1,557 @@
+"""Tests for the interprocedural concurrency analyzer (ISSUE 19):
+threadlint rules on good/bad fixture programs, the thread/lock
+vocabulary's contracts, the shared registry loader, the C-side
+blocking-under-mutex twin in comm_parity, and the repo-wide dogfood
+run.
+
+Named ``test_zz_*`` to sort LAST: tier-1 is timeout-bound, and
+everything here is pure ast/text work (no jit compiles, no jax
+import), so the whole module stays in low single-digit seconds.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+from tools import comm_parity  # noqa: E402
+from tools.registry_load import load_registry_module  # noqa: E402
+from tools.threadlint import (  # noqa: E402
+    DEFAULT_TARGETS, LINT_VERSION, RULES, Lock, Registry, Root,
+    lint_files, lint_repo, load_default_registry)
+from tools.threadlint.__main__ import selftest  # noqa: E402
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+def reg(**kw):
+    """Synthetic vocabulary builder with fixture-friendly defaults."""
+    kw.setdefault("blocking_calls", {"os.fsync": "fsync",
+                                     "time.sleep": "sleep"})
+    return Registry(**kw)
+
+
+# --------------------------------------------------------- TL001 fence
+
+JAX_BAD = """\
+import threading
+import jax
+
+def work(x):
+    jax.device_put(x)
+
+def start():
+    threading.Thread(target=work).start()
+"""
+
+
+def test_tl001_jax_from_non_jax_ok_root():
+    r = reg(roots=[Root("bg", "thread", "app.work", False)])
+    fs = lint_files({"app.py": JAX_BAD}, r)
+    assert "TL001" in rules_of(fs)
+    assert any("bg" in f.msg for f in fs if f.rule == "TL001")
+
+
+def test_tl001_clean_when_root_is_jax_ok():
+    r = reg(roots=[Root("dispatch", "thread", "app.work", True)])
+    assert "TL001" not in rules_of(lint_files({"app.py": JAX_BAD}, r))
+
+
+def test_tl001_interprocedural_and_lambda():
+    # the jax touch is two hops away, reached through a helper that
+    # runs a lambda — resolution must survive both
+    src = (
+        "import threading\n"
+        "import jax\n"
+        "def guarded(thunk):\n"
+        "    return thunk()\n"
+        "def inner(x):\n"
+        "    return jax.device_put(x)\n"
+        "def loop():\n"
+        "    guarded(lambda: inner(1))\n"
+        "def start():\n"
+        "    threading.Thread(target=loop).start()\n")
+    r = reg(roots=[Root("bg", "thread", "app.loop", False)])
+    assert "TL001" in rules_of(lint_files({"app.py": src}, r))
+
+
+# ---------------------------------------------------- TL002 lock order
+
+CYCLE = """\
+import threading
+
+A = threading.Lock()
+B = threading.Lock()
+
+def one():
+    with A:
+        with B:
+            pass
+
+def other():
+    with B:
+        with A:
+            pass
+"""
+
+
+def test_tl002_synthetic_cycle_and_rank_inversion():
+    r = reg(roots=[Root("r1", "thread", "m.one", False),
+                   Root("r2", "thread", "m.other", False)],
+            locks=[Lock("a", 10, "m.A"), Lock("b", 20, "m.B")])
+    fs = [f for f in lint_files({"m.py": CYCLE}, r) if f.rule == "TL002"]
+    assert fs, "cycle must fire TL002"
+    msgs = " | ".join(f.msg for f in fs)
+    assert "cycle" in msgs and "rank" in msgs
+
+
+def test_tl002_rank_ordered_nesting_is_clean():
+    src = ("import threading\n"
+           "A = threading.Lock()\n"
+           "B = threading.Lock()\n"
+           "def fine():\n"
+           "    with A:\n"
+           "        with B:\n"
+           "            pass\n")
+    r = reg(roots=[Root("r", "thread", "m.fine", False)],
+            locks=[Lock("a", 10, "m.A"), Lock("b", 20, "m.B")])
+    assert "TL002" not in rules_of(lint_files({"m.py": src}, r))
+
+
+def test_tl002_reacquire_needs_reentrant_registration():
+    src = ("import threading\n"
+           "L = threading.Lock()\n"
+           "def outer():\n"
+           "    with L:\n"
+           "        inner()\n"
+           "def inner():\n"
+           "    with L:\n"
+           "        pass\n")
+    plain = reg(roots=[Root("r", "thread", "m.outer", False)],
+                locks=[Lock("l", 10, "m.L")])
+    assert "TL002" in rules_of(lint_files({"m.py": src}, plain))
+    rlock = reg(roots=[Root("r", "thread", "m.outer", False)],
+                locks=[Lock("l", 10, "m.L", reentrant=True)])
+    assert "TL002" not in rules_of(lint_files({"m.py": src}, rlock))
+
+
+def test_tl002_interprocedural_edge():
+    # the nesting spans a call: outer holds A, callee takes B, B<A rank
+    src = ("import threading\n"
+           "A = threading.Lock()\n"
+           "B = threading.Lock()\n"
+           "def outer():\n"
+           "    with A:\n"
+           "        helper()\n"
+           "def helper():\n"
+           "    with B:\n"
+           "        pass\n")
+    r = reg(roots=[Root("r", "thread", "m.outer", False)],
+            locks=[Lock("a", 20, "m.A"), Lock("b", 10, "m.B")])
+    fs = [f for f in lint_files({"m.py": src}, r) if f.rule == "TL002"]
+    assert fs and "rank inversion" in fs[0].msg
+
+
+# ---------------------------------------- TL003 blocking under lock
+
+def test_tl003_fsync_under_lock_fires_outside_clean():
+    bad = ("import threading\nimport os\n"
+           "L = threading.Lock()\n"
+           "def flush(fd):\n"
+           "    with L:\n"
+           "        os.fsync(fd)\n")
+    good = ("import threading\nimport os\n"
+            "L = threading.Lock()\n"
+            "def flush(fd):\n"
+            "    with L:\n"
+            "        pass\n"
+            "    os.fsync(fd)\n")
+    r = reg(locks=[Lock("l", 10, "m.L")])
+    assert "TL003" in rules_of(lint_files({"m.py": bad}, r))
+    assert "TL003" not in rules_of(lint_files({"m.py": good}, r))
+
+
+def test_tl003_compile_under_lock_interprocedural():
+    # compile reached through a call while the caller holds the lock —
+    # the PR 15 _build_detached invariant as a fixture
+    src = ("import threading\n"
+           "L = threading.Lock()\n"
+           "def compile_sort(key):\n"
+           "    return key\n"
+           "def get(key):\n"
+           "    with L:\n"
+           "        return build(key)\n"
+           "def build(key):\n"
+           "    return compile_sort(key)\n")
+    r = reg(roots=[Root("r", "thread", "m.get", True)],
+            locks=[Lock("l", 10, "m.L")],
+            compile_funcs=("m.compile_sort",))
+    fs = [f for f in lint_files({"m.py": src}, r) if f.rule == "TL003"]
+    assert fs and "XLA compile" in fs[0].msg
+
+
+def test_tl003_reasoned_suppression_severs_propagation():
+    # suppressing the reviewed call site must also silence the SAME
+    # hazard at interior blocking touches reached through that call
+    src = ("import threading\nimport time\n"
+           "L = threading.Lock()\n"
+           "def get(key):\n"
+           "    with L:\n"
+           "        # threadlint: disable=TL003 -- reviewed hold\n"
+           "        return build(key)\n"
+           "def build(key):\n"
+           "    time.sleep(0.1)\n")
+    r = reg(roots=[Root("r", "thread", "m.get", True)],
+            locks=[Lock("l", 10, "m.L")])
+    assert "TL003" not in rules_of(lint_files({"m.py": src}, r))
+
+
+# ------------------------------------------- TL004 shared-write lockset
+
+SHARED = """\
+import threading
+
+class Cell:
+    def __init__(self):
+        self.value = 0
+        self.lock = threading.Lock()
+
+    def writer_a(self):
+        {a}
+
+    def writer_b(self):
+        {b}
+
+def start(c):
+    threading.Thread(target=c.writer_a).start()
+    threading.Thread(target=c.writer_b).start()
+"""
+
+
+def _shared_reg():
+    return reg(roots=[Root("wa", "thread", "m.Cell.writer_a", False),
+                      Root("wb", "thread", "m.Cell.writer_b", False)],
+               locks=[Lock("cell", 10, "m.Cell.lock")])
+
+
+def test_tl004_two_roots_no_common_lock():
+    src = SHARED.format(a="self.value = 1", b="self.value = 2")
+    fs = [f for f in lint_files({"m.py": src}, _shared_reg())
+          if f.rule == "TL004"]
+    assert fs and "m.Cell.value" in fs[0].msg
+
+
+def test_tl004_common_lock_on_every_path_is_clean():
+    src = SHARED.format(
+        a="with self.lock:\n            self.value = 1",
+        b="with self.lock:\n            self.value = 2")
+    assert "TL004" not in rules_of(
+        lint_files({"m.py": src}, _shared_reg()))
+
+
+def test_tl004_one_unlocked_path_still_fires():
+    src = SHARED.format(
+        a="with self.lock:\n            self.value = 1",
+        b="self.value = 2")
+    assert "TL004" in rules_of(lint_files({"m.py": src}, _shared_reg()))
+
+
+def test_tl004_atomic_ok_exemption():
+    src = SHARED.format(a="self.value = 1", b="self.value = 2")
+    r = reg(roots=[Root("wa", "thread", "m.Cell.writer_a", False),
+                   Root("wb", "thread", "m.Cell.writer_b", False)],
+            locks=[Lock("cell", 10, "m.Cell.lock")],
+            atomic_ok=("m.Cell.value",))
+    assert "TL004" not in rules_of(lint_files({"m.py": src}, r))
+
+
+def test_tl004_init_and_fresh_locals_are_confined():
+    # __init__ writes and writes through a same-function constructor
+    # call are thread-confined, not shared state
+    src = ("import threading\n"
+           "class Box:\n"
+           "    def __init__(self):\n"
+           "        self.n = 0\n"
+           "def parse():\n"
+           "    b = Box()\n"
+           "    b.n = 41\n"
+           "    return b\n"
+           "def also_parse():\n"
+           "    b = Box()\n"
+           "    b.n = 42\n"
+           "    return b\n"
+           "def start():\n"
+           "    threading.Thread(target=parse).start()\n"
+           "    threading.Thread(target=also_parse).start()\n")
+    r = reg(roots=[Root("p1", "thread", "m.parse", False),
+                   Root("p2", "thread", "m.also_parse", False)])
+    assert "TL004" not in rules_of(lint_files({"m.py": src}, r))
+
+
+def test_tl004_module_global_writes():
+    src = ("import threading\n"
+           "_cache = None\n"
+           "def fill_a():\n"
+           "    global _cache\n"
+           "    _cache = 1\n"
+           "def fill_b():\n"
+           "    global _cache\n"
+           "    _cache = 2\n"
+           "def start():\n"
+           "    threading.Thread(target=fill_a).start()\n"
+           "    threading.Thread(target=fill_b).start()\n")
+    r = reg(roots=[Root("a", "thread", "m.fill_a", False),
+                   Root("b", "thread", "m.fill_b", False)])
+    fs = [f for f in lint_files({"m.py": src}, r) if f.rule == "TL004"]
+    assert fs and "m._cache" in fs[0].msg
+
+
+# ------------------------------------------------- TL005 GIL wedge
+
+def test_tl005_wedge_call_outside_probe_home():
+    src = ("def peek(client):\n"
+           "    return client.get_topology_desc()\n")
+    r = reg(gil_wedge_calls=("get_topology_desc",),
+            gil_wedge_home=("pkg/probe.py",))
+    assert "TL005" in rules_of(lint_files({"pkg/other.py": src}, r))
+    assert "TL005" not in rules_of(lint_files({"pkg/probe.py": src}, r))
+
+
+# ------------------------------------------- TL010/TL011 vocabulary
+
+def test_tl010_unregistered_thread_and_bare_pool():
+    src = ("import threading\n"
+           "from concurrent.futures import ThreadPoolExecutor\n"
+           "def job():\n"
+           "    pass\n"
+           "def start():\n"
+           "    threading.Thread(target=job).start()\n"
+           "    ex = ThreadPoolExecutor(2)\n"
+           "    ex.submit(job)\n")
+    fs = lint_files({"m.py": src}, reg())
+    msgs = [f.msg for f in fs if f.rule == "TL010"]
+    assert len(msgs) == 3  # thread target, naked pool, submit target
+    assert any("thread_name_prefix" in m for m in msgs)
+
+
+def test_tl010_registered_sites_are_clean():
+    src = ("import threading\n"
+           "from concurrent.futures import ThreadPoolExecutor\n"
+           "def job():\n"
+           "    pass\n"
+           "def start():\n"
+           "    threading.Thread(target=job).start()\n"
+           "    ex = ThreadPoolExecutor(2, thread_name_prefix='w')\n"
+           "    ex.submit(job)\n")
+    r = reg(roots=[Root("job", "thread", "m.job", False)])
+    assert "TL010" not in rules_of(lint_files({"m.py": src}, r))
+
+
+def test_tl010_handler_and_signal_entries():
+    src = ("import signal\n"
+           "import socketserver\n"
+           "class H(socketserver.StreamRequestHandler):\n"
+           "    def handle(self):\n"
+           "        pass\n"
+           "def on_term(sig, frame):\n"
+           "    pass\n"
+           "def install():\n"
+           "    signal.signal(signal.SIGTERM, on_term)\n")
+    fs = lint_files({"m.py": src}, reg())
+    assert sum(1 for f in fs if f.rule == "TL010") == 2
+    r = reg(roots=[Root("h", "handler", "m.H.handle", False),
+                   Root("s", "signal", "m.on_term", False)])
+    assert "TL010" not in rules_of(lint_files({"m.py": src}, r))
+
+
+def test_tl011_unregistered_lock_and_condition_alias():
+    bad = "import threading\nSTRAY = threading.Lock()\n"
+    assert rules_of(lint_files({"m.py": bad}, reg())) == ["TL011"]
+    # a Condition wrapping a registered lock aliases it — no finding
+    src = ("import threading\n"
+           "class A:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self._idle = threading.Condition(self._lock)\n")
+    r = reg(locks=[Lock("a", 10, "m.A._lock")])
+    assert "TL011" not in rules_of(lint_files({"m.py": src}, r))
+
+
+# ------------------------------------------- suppression grammar
+
+def test_suppression_reasoned_works_reasonless_is_tl000():
+    reasoned = ("import threading\n"
+                "L = threading.Lock()"
+                "  # threadlint: disable=TL011 -- fixture lock\n")
+    assert rules_of(lint_files({"m.py": reasoned}, reg())) == []
+    bare = ("import threading\n"
+            "L = threading.Lock()  # threadlint: disable=TL011\n")
+    fs = lint_files({"m.py": bare}, reg())
+    assert rules_of(fs) == ["TL000", "TL011"], \
+        "reasonless directive must not suppress AND must fire TL000"
+
+
+def test_suppression_line_above_and_wrong_id():
+    above = ("import threading\n"
+             "# threadlint: disable=TL011 -- fixture lock\n"
+             "L = threading.Lock()\n")
+    assert rules_of(lint_files({"m.py": above}, reg())) == []
+    wrong = ("import threading\n"
+             "L = threading.Lock()"
+             "  # threadlint: disable=TL003 -- wrong id\n")
+    assert "TL011" in rules_of(lint_files({"m.py": wrong}, reg()))
+
+
+# ------------------------------------------------ vocabulary contracts
+
+def test_vocabulary_pins():
+    mod = load_registry_module(
+        "_test_thread_registry",
+        REPO / "mpitest_tpu" / "utils" / "thread_registry.py",
+        register=True)
+    names = [r.name for r in mod.THREAD_ROOTS]
+    entries = [r.entry for r in mod.THREAD_ROOTS]
+    assert len(set(names)) == len(names), "root names must be unique"
+    assert len(set(entries)) == len(entries), "entries must be unique"
+    for r in mod.THREAD_ROOTS:
+        assert r.kind in mod.ROOT_KINDS
+        assert r.doc.strip(), f"root {r.name} needs a doc"
+    # the jax_ok grant list is closed and audited — additions are a
+    # REVIEWED act, so pin the exact set
+    assert {r.name for r in mod.THREAD_ROOTS if r.jax_ok} == {
+        "serve-dispatch", "serve-tuner-prewarm", "ingest-xfer",
+        "egress-fetch", "server-main"}
+    ranks = [l.rank for l in mod.LOCKS]
+    sites = [l.site for l in mod.LOCKS]
+    assert len(set(ranks)) == len(ranks), "lock ranks must be unique"
+    assert len(set(sites)) == len(sites), "lock sites must be unique"
+    for l in mod.LOCKS:
+        assert l.doc.strip(), f"lock {l.name} needs a doc"
+    # the only reentrant lock today is the flight ring
+    assert [l.name for l in mod.LOCKS if l.reentrant] == ["flight.ring"]
+    # alias targets must be registered sites
+    for target in mod.LOCK_ALIASES.values():
+        assert target in sites
+
+
+def test_registry_rejects_duplicates():
+    with pytest.raises(ValueError):
+        Registry(roots=[Root("a", "thread", "m.f", False),
+                        Root("b", "thread", "m.f", False)])
+    with pytest.raises(ValueError):
+        Registry(locks=[Lock("a", 1, "m.L"), Lock("b", 2, "m.L")])
+
+
+def test_default_targets_exclude_tests_and_tools():
+    assert "tests" not in DEFAULT_TARGETS
+    assert "tools" not in DEFAULT_TARGETS
+
+
+# -------------------------------------------------- shared loader
+
+def test_load_registry_module(tmp_path):
+    p = tmp_path / "my_registry.py"
+    p.write_text("VALUE = 41\n")
+    mod = load_registry_module("_test_loader_mod", p)
+    assert mod.VALUE == 41
+    assert "_test_loader_mod" not in sys.modules
+    mod2 = load_registry_module("_test_loader_reg", p, register=True)
+    assert sys.modules["_test_loader_reg"] is mod2
+    del sys.modules["_test_loader_reg"]
+    with pytest.raises(FileNotFoundError):
+        load_registry_module("_test_loader_nope", tmp_path / "no.py")
+
+
+# ---------------------------------------- comm_parity C-side twin
+
+C_BAD = """\
+static pthread_mutex_t stats_mu;
+void tally(void) {
+    pthread_mutex_lock(&stats_mu);
+    comm_barrier(world);
+    pthread_mutex_unlock(&stats_mu);
+}
+"""
+
+C_GOOD = """\
+static pthread_mutex_t stats_mu;
+void tally(void) {
+    pthread_mutex_lock(&stats_mu);
+    stats.n += 1;
+    pthread_mutex_unlock(&stats_mu);
+    comm_barrier(world);
+}
+"""
+
+C_ESCAPED = """\
+static pthread_mutex_t stats_mu;
+void tally(void) {
+    pthread_mutex_lock(&stats_mu);
+    /* parity: ok -- bounded: peers already arrived (handshake) */
+    comm_barrier(world);
+    pthread_mutex_unlock(&stats_mu);
+}
+"""
+
+
+def test_c_mutex_blocking_collective():
+    bad = comm_parity.check_mutex_blocking_collectives(C_BAD, "x.c")
+    assert len(bad) == 1 and "comm_barrier" in bad[0] \
+        and "stats_mu" in bad[0]
+    assert comm_parity.check_mutex_blocking_collectives(
+        C_GOOD, "x.c") == []
+    assert comm_parity.check_mutex_blocking_collectives(
+        C_ESCAPED, "x.c") == []
+
+
+def test_c_mutex_twin_covers_mpi_and_barrier_surface():
+    src = ("void f(void) {\n"
+           "    pthread_mutex_lock(&mu);\n"
+           "    MPI_Allreduce(a, b, 1, MPI_INT, MPI_SUM, comm);\n"
+           "    pthread_barrier_wait(&bar);\n"
+           "    pthread_mutex_unlock(&mu);\n"
+           "}\n")
+    out = comm_parity.check_mutex_blocking_collectives(src, "x.c")
+    assert len(out) == 2
+
+
+def test_real_backends_have_no_mutex_blocking_findings():
+    for backend in ("comm/comm_local.c", "comm/comm_mpi.c"):
+        src = (REPO / backend).read_text()
+        assert comm_parity.check_mutex_blocking_collectives(
+            src, backend) == []
+
+
+# ------------------------------------------------------- dogfood
+
+def test_repo_lints_clean():
+    findings = lint_repo(REPO)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_selftest_every_rule_fires(capsys):
+    assert selftest() == 0
+    out = capsys.readouterr().out
+    assert "all 8 rules fire" in out
+
+
+def test_rule_table_matches_version():
+    assert LINT_VERSION == "threadlint.v1"
+    assert set(RULES) == {"TL000", "TL001", "TL002", "TL003", "TL004",
+                          "TL005", "TL010", "TL011", "TL999"}
+
+
+def test_real_registry_loads_and_traverses():
+    # the default registry must normalize and every serve-layer root
+    # must resolve to a real function in the program
+    registry = load_default_registry(REPO)
+    assert "mpitest_tpu.serve.batching.Batcher._loop" in registry.roots
+    assert registry.roots[
+        "mpitest_tpu.serve.batching.Batcher._loop"].jax_ok

@@ -136,6 +136,64 @@ def check_rank_conditional_collectives(path: Path) -> list[str]:
     return findings
 
 
+#: Blocking C calls that must never run while a pthread mutex is held
+#: (threadlint TL003's C-side twin): every comm_* collective, the raw
+#: MPI blocking surface, and pthread barriers.  A rank stalled inside
+#: one of these while holding the stats mutex blocks every other thread
+#: touching the stats for as long as the slowest PEER takes to arrive.
+_C_BLOCKING = _COLLECTIVES + (
+    "MPI_Barrier", "MPI_Bcast", "MPI_Scatter", "MPI_Scatterv",
+    "MPI_Gather", "MPI_Gatherv", "MPI_Allgather", "MPI_Allgatherv",
+    "MPI_Allreduce", "MPI_Reduce", "MPI_Alltoall", "MPI_Alltoallv",
+    "MPI_Exscan", "MPI_Scan", "MPI_Send", "MPI_Recv", "MPI_Sendrecv",
+    "MPI_Wait", "MPI_Waitall", "pthread_barrier_wait",
+)
+
+_MUTEX_LOCK_RE = re.compile(r"\bpthread_mutex_lock\s*\(\s*&?\s*([\w.\->\[\]]+)")
+_MUTEX_UNLOCK_RE = re.compile(
+    r"\bpthread_mutex_unlock\s*\(\s*&?\s*([\w.\->\[\]]+)")
+
+
+def check_mutex_blocking_collectives(src: str,
+                                     name: str) -> list[str]:
+    """threadlint TL003's C-side twin (regex-level): flag any blocking
+    collective called while a ``pthread_mutex_lock`` region is open.
+
+    Linear scan tracking the set of currently-locked mutex names
+    (``pthread_mutex_lock(&m)`` opens, ``pthread_mutex_unlock(&m)``
+    closes); a :data:`_C_BLOCKING` call on a line with a nonempty set
+    is a finding.  ``/* parity: ok -- <reason> */`` on the call line or
+    the line above passes it."""
+    stripped = _strip_comments(src)
+    raw_lines = src.splitlines()
+    held: set[str] = set()
+    findings: list[str] = []
+    blocking_re = re.compile(r"\b(" + "|".join(_C_BLOCKING) + r")\s*\(")
+    for i, line in enumerate(stripped.splitlines(), 1):
+        events = [(m.start(), "lock", m.group(1))
+                  for m in _MUTEX_LOCK_RE.finditer(line)]
+        events += [(m.start(), "unlock", m.group(1))
+                   for m in _MUTEX_UNLOCK_RE.finditer(line)]
+        events += [(m.start(), "block", m.group(1))
+                   for m in blocking_re.finditer(line)]
+        for _pos, kind, what in sorted(events):
+            if kind == "lock":
+                held.add(what)
+            elif kind == "unlock":
+                held.discard(what)
+            elif held:
+                window = raw_lines[max(0, i - 2):i]
+                if any(_OK_RE.search(w) for w in window):
+                    continue
+                findings.append(
+                    f"{name}:{i}: {what} while holding mutex(es) "
+                    f"{', '.join(sorted(held))} — a peer-paced "
+                    "blocking call under a lock stalls every thread "
+                    "contending on it; annotate `/* parity: ok -- "
+                    "<reason> */` if the hold is provably bounded")
+    return findings
+
+
 def collective_sequence(path: Path) -> list[str]:
     src = _strip_comments(path.read_text())
     return [m.group(1) for m in
@@ -198,6 +256,12 @@ def main() -> int:
     for sym in sorted(enc_defined - set(enc_declared)):
         errors.append(f"native/encode.c: defines {sym} which encode.h "
                       "does not declare (shim-invisible API surface)")
+
+    # Blocking-under-mutex (threadlint TL003's C-side twin) over both
+    # backends — the stats mutex must never pend on a peer.
+    for backend in ("comm/comm_local.c", "comm/comm_mpi.c"):
+        errors.extend(check_mutex_blocking_collectives(
+            (REPO / backend).read_text(), backend))
 
     # Sorter call-sequences + the deadlock smell.
     for sorter in ("native/sample_sort.c", "native/radix_sort.c"):

@@ -1,0 +1,44 @@
+"""Shared file-path registry loader for the lint tools.
+
+Both linters (sortlint, threadlint) check source against the repo's
+REAL registries — span schema, metric vocabulary, plan decisions,
+planner policies, doctor rules, thread roots/locks — without ever
+importing the ``mpitest_tpu`` package: the registry modules are
+stdlib-only by design, so loading them by file path keeps the CI lint
+job free of jax/numpy.  This helper is that loader, factored out of the
+five near-identical ``_load_*`` functions sortlint's SL003/SL004/SL005/
+SL006/SL007 grew one PR at a time.
+
+``register=True`` inserts the module into ``sys.modules`` BEFORE exec:
+registries that declare dataclasses need it (dataclass processing looks
+the defining module up by name), while pure-dict registries don't.  The
+alias deliberately carries a private per-tool prefix (``_sortlint_*``,
+``_threadlint_*``) so a file-path load can never shadow a real package
+import in the same process (the test suite imports both).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import sys
+from pathlib import Path
+from typing import Any
+
+
+def load_registry_module(alias: str, path: Path, *,
+                         register: bool = False) -> Any:
+    """Exec ``path`` as a standalone module named ``alias`` and return
+    it.  Raises ``FileNotFoundError`` for a missing file and whatever
+    the module itself raises on exec — a registry that fails to load is
+    a lint-tool configuration bug, never silently skipped."""
+    if not path.is_file():
+        raise FileNotFoundError(f"registry module not found: {path}")
+    spec = importlib.util.spec_from_file_location(alias, path)
+    assert spec is not None and spec.loader is not None
+    mod = importlib.util.module_from_spec(spec)
+    if register:
+        # dataclass-bearing registries: processing looks the module up
+        # in sys.modules during exec, so insert first
+        sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod

@@ -11,27 +11,18 @@ a copy.
 from __future__ import annotations
 
 import ast
-import importlib.util
 import re
 from pathlib import Path
-from typing import Any, Iterator
+from typing import Iterator
 
+from tools.registry_load import load_registry_module
 from tools.sortlint import Finding, Rule, register
 
 REPO_ROOT = Path(__file__).resolve().parents[2]
 
-
-def _load_span_schema() -> Any:
-    path = REPO_ROOT / "mpitest_tpu" / "utils" / "span_schema.py"
-    spec = importlib.util.spec_from_file_location("_sortlint_span_schema",
-                                                  path)
-    assert spec is not None and spec.loader is not None
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
-
-
-_SCHEMA = _load_span_schema()
+_SCHEMA = load_registry_module(
+    "_sortlint_span_schema",
+    REPO_ROOT / "mpitest_tpu" / "utils" / "span_schema.py")
 
 
 def _ends(path: str, *suffixes: str) -> bool:
@@ -207,19 +198,11 @@ register(Rule(
 
 # ---------------------------------------------------------------- SL004
 
-def _load_metrics_registry() -> Any:
-    """utils/metrics_live.py by file path (stdlib-only by design, like
-    span_schema) — SL004 checks against the real METRICS dict."""
-    path = REPO_ROOT / "mpitest_tpu" / "utils" / "metrics_live.py"
-    spec = importlib.util.spec_from_file_location(
-        "_sortlint_metrics_live", path)
-    assert spec is not None and spec.loader is not None
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
-
-
-_METRICS_MOD = _load_metrics_registry()
+#: utils/metrics_live.py by file path (stdlib-only by design, like
+#: span_schema) — SL004 checks against the real METRICS dict.
+_METRICS_MOD = load_registry_module(
+    "_sortlint_metrics_live",
+    REPO_ROOT / "mpitest_tpu" / "utils" / "metrics_live.py")
 
 #: The module that IS the metric registry — the rule polices its users.
 _METRICS_EXEMPT = ("mpitest_tpu/utils/metrics_live.py",)
@@ -281,24 +264,13 @@ register(Rule(
 
 # ---------------------------------------------------------------- SL005
 
-def _load_plan_schema() -> Any:
-    """models/plan.py by file path (stdlib-only at import by design,
-    like span_schema) — SL005 checks against the real PLAN_DECISIONS."""
-    import sys
-
-    path = REPO_ROOT / "mpitest_tpu" / "models" / "plan.py"
-    spec = importlib.util.spec_from_file_location("_sortlint_plan", path)
-    assert spec is not None and spec.loader is not None
-    mod = importlib.util.module_from_spec(spec)
-    # plan.py declares dataclasses, whose processing looks the module
-    # up in sys.modules — register before exec (span_schema/metrics
-    # carry none, so their loaders skip this)
-    sys.modules[spec.name] = mod
-    spec.loader.exec_module(mod)
-    return mod
-
-
-_PLAN_MOD = _load_plan_schema()
+#: models/plan.py by file path (stdlib-only at import by design, like
+#: span_schema) — SL005 checks against the real PLAN_DECISIONS.
+#: plan.py declares dataclasses -> register=True (span_schema/metrics
+#: carry none, so their loads skip it).
+_PLAN_MOD = load_registry_module(
+    "_sortlint_plan",
+    REPO_ROOT / "mpitest_tpu" / "models" / "plan.py", register=True)
 
 #: The module that IS the decision registry — the rule polices users.
 _PLAN_EXEMPT = ("mpitest_tpu/models/plan.py",)
@@ -355,24 +327,12 @@ register(Rule(
 
 # ---------------------------------------------------------------- SL006
 
-def _load_planner_schema() -> Any:
-    """models/planner.py by file path (stdlib-only at import by design,
-    like plan.py) — SL006 checks against the real PLANNER_POLICIES."""
-    import sys
-
-    path = REPO_ROOT / "mpitest_tpu" / "models" / "planner.py"
-    spec = importlib.util.spec_from_file_location("_sortlint_planner",
-                                                  path)
-    assert spec is not None and spec.loader is not None
-    mod = importlib.util.module_from_spec(spec)
-    # planner.py declares dataclasses — register before exec, like the
-    # plan.py loader above
-    sys.modules[spec.name] = mod
-    spec.loader.exec_module(mod)
-    return mod
-
-
-_PLANNER_MOD = _load_planner_schema()
+#: models/planner.py by file path (stdlib-only at import by design,
+#: like plan.py, dataclasses included) — SL006 checks against the real
+#: PLANNER_POLICIES.
+_PLANNER_MOD = load_registry_module(
+    "_sortlint_planner",
+    REPO_ROOT / "mpitest_tpu" / "models" / "planner.py", register=True)
 
 #: The module that IS the policy registry — the rule polices users.
 _PLANNER_EXEMPT = ("mpitest_tpu/models/planner.py",)
@@ -448,24 +408,12 @@ register(Rule(
 
 # ---------------------------------------------------------------- SL007
 
-def _load_doctor_schema() -> Any:
-    """mpitest_tpu/doctor.py by file path (stdlib-only at import by
-    design, like plan.py) — SL007 checks against the real
-    DOCTOR_RULES."""
-    import sys
-
-    path = REPO_ROOT / "mpitest_tpu" / "doctor.py"
-    spec = importlib.util.spec_from_file_location("_sortlint_doctor", path)
-    assert spec is not None and spec.loader is not None
-    mod = importlib.util.module_from_spec(spec)
-    # doctor.py declares dataclasses — register before exec, like the
-    # plan.py loader above
-    sys.modules[spec.name] = mod
-    spec.loader.exec_module(mod)
-    return mod
-
-
-_DOCTOR_MOD = _load_doctor_schema()
+#: mpitest_tpu/doctor.py by file path (stdlib-only at import by
+#: design, like plan.py, dataclasses included) — SL007 checks against
+#: the real DOCTOR_RULES.
+_DOCTOR_MOD = load_registry_module(
+    "_sortlint_doctor", REPO_ROOT / "mpitest_tpu" / "doctor.py",
+    register=True)
 
 #: The module that IS the rule registry — SL007 polices users.
 _DOCTOR_EXEMPT = ("mpitest_tpu/doctor.py",)

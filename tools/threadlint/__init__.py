@@ -1,0 +1,268 @@
+"""threadlint — interprocedural concurrency lint for the serving stack.
+
+sortlint checks per-file invariants; threadlint checks the ones that
+live BETWEEN files: which thread runs what.  It builds a call graph of
+``mpitest_tpu/``, ``drivers/`` and ``bench/`` (pure ``ast``, zero deps,
+never imports the package under lint), walks it from every thread root
+registered in ``mpitest_tpu/utils/thread_registry.py``, and enforces:
+
+========  ===============================================================
+TL001     **JAX fence** — the JAX/XLA surface (``jax.*``/``jnp.*``,
+          ``device_put``/``checked_device_put``, ``block_until_ready``,
+          executor-cache ``get_packed``, ``compile_packed_sort``) is
+          reachable only from roots registered ``jax_ok`` (the dispatch
+          thread, the tuner prewarm, the ingest transfer/egress fetch
+          stages, process main).
+TL002     **lock order** — ``with <lock>`` nesting across the call
+          graph must follow the registry's global rank order (strictly
+          increasing); any cycle, rank inversion, or non-reentrant
+          re-acquisition is a finding.
+TL003     **blocking under lock** — fsync / socket send-recv /
+          subprocess / sleep / XLA compile reachable while a registered
+          lock is held.  The PR 15 ``_build_detached``
+          compile-outside-the-lock fix is a checked invariant.
+TL004     **unfenced shared write** — an attribute written from >= 2
+          thread roots with no common lock on every write path
+          (classic Eraser lockset discipline).
+TL005     **GIL wedge** — registered can-block-forever-holding-the-GIL
+          calls (``get_topology_desc``) are legal only inside the
+          bounded-subprocess probe module.
+TL010     unregistered thread root: every ``threading.Thread``, pool
+          submit target, socketserver/http handler entry and signal
+          handler must name a root in the registry; pools must carry
+          ``thread_name_prefix``.
+TL011     unregistered lock: every Lock/RLock/Condition creation site
+          must carry a registered :class:`LockDecl` (name + rank).
+========  ===============================================================
+
+Suppressions mirror sortlint's reasoned grammar::
+
+    risky()  # threadlint: disable=TL003 -- compile dogpile tradeoff
+
+A directive without a reason is itself a finding (TL000) and does not
+suppress.  ``make lint`` runs threadlint beside sortlint in CI.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Iterable, Optional
+
+from tools.registry_load import load_registry_module
+from tools.sortlint import iter_target_files
+
+LINT_VERSION = "threadlint.v1"
+
+#: Default lint targets relative to the repo root.  tests/ is excluded
+#: (fixtures there violate the rules on purpose); tools/ is excluded
+#: because the analyzer does not lint itself.
+DEFAULT_TARGETS = ("mpitest_tpu", "drivers", "bench.py", "bench")
+
+#: Static rule table (--list-rules, README).
+RULES: dict[str, str] = {
+    "TL000": "suppression directive without a reason (and not honored)",
+    "TL001": "JAX surface reached from a thread root not marked jax_ok",
+    "TL002": "lock-order violation: cycle, rank inversion, or "
+             "non-reentrant re-acquisition",
+    "TL003": "blocking call (fsync/socket/subprocess/sleep/XLA compile) "
+             "reachable while a registered lock is held",
+    "TL004": "attribute written from >=2 thread roots with no common "
+             "lock on every write path",
+    "TL005": "GIL-wedge call outside the bounded-subprocess probe",
+    "TL010": "unregistered thread root (Thread/pool submit/handler/"
+             "signal) or pool without thread_name_prefix",
+    "TL011": "unregistered lock creation site",
+    "TL999": "target file failed to parse",
+}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*threadlint:\s*disable=(?P<ids>[A-Z0-9, ]+?)"
+    r"(?:\s*--\s*(?P<reason>.*\S))?\s*$")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    msg: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.msg}"
+
+
+# ------------------------------------------------------------ registry
+
+@dataclass(frozen=True)
+class Root:
+    name: str
+    kind: str
+    entry: str
+    jax_ok: bool
+
+
+@dataclass(frozen=True)
+class Lock:
+    name: str
+    rank: int
+    site: str
+    reentrant: bool = False
+
+
+class Registry:
+    """Normalized vocabulary the engine and rules consume — built from
+    the real ``thread_registry`` module or synthesized by tests."""
+
+    def __init__(self, *, roots: Iterable = (), locks: Iterable = (),
+                 lock_aliases: Optional[dict] = None,
+                 receiver_types: Optional[dict] = None,
+                 attr_calls: Optional[dict] = None,
+                 return_types: Optional[dict] = None,
+                 extra_edges: Optional[dict] = None,
+                 jax_surface_heads: Iterable[str] = ("jax", "jnp"),
+                 jax_surface_calls: Iterable[str] = (),
+                 blocking_calls: Optional[dict] = None,
+                 compile_funcs: Iterable[str] = (),
+                 gil_wedge_calls: Iterable[str] = (),
+                 gil_wedge_home: Iterable[str] = (),
+                 atomic_ok: Iterable[str] = ()) -> None:
+        self.roots: dict[str, Root] = {}
+        for r in roots:
+            root = r if isinstance(r, Root) else Root(
+                r.name, r.kind, r.entry, r.jax_ok)
+            if root.entry in self.roots:
+                raise ValueError(f"duplicate root entry {root.entry}")
+            self.roots[root.entry] = root
+        self.locks: dict[str, Lock] = {}
+        for l in locks:
+            lock = l if isinstance(l, Lock) else Lock(
+                l.name, l.rank, l.site, getattr(l, "reentrant", False))
+            if lock.site in self.locks:
+                raise ValueError(f"duplicate lock site {lock.site}")
+            self.locks[lock.site] = lock
+        self.lock_sites = set(self.locks)
+        self.lock_aliases = dict(lock_aliases or {})
+        self.receiver_types = dict(receiver_types or {})
+        self.attr_calls = dict(attr_calls or {})
+        self.return_types = dict(return_types or {})
+        self.extra_edges = dict(extra_edges or {})
+        self.jax_surface_heads = tuple(jax_surface_heads)
+        self.jax_surface_calls = tuple(jax_surface_calls)
+        self.blocking_calls = dict(blocking_calls or {})
+        self.compile_funcs = tuple(compile_funcs)
+        self.gil_wedge_calls = tuple(gil_wedge_calls)
+        self.gil_wedge_home = tuple(gil_wedge_home)
+        self.atomic_ok = tuple(atomic_ok)
+
+    @classmethod
+    def from_module(cls, mod) -> "Registry":
+        return cls(
+            roots=mod.THREAD_ROOTS, locks=mod.LOCKS,
+            lock_aliases=mod.LOCK_ALIASES,
+            receiver_types=mod.RECEIVER_TYPES,
+            attr_calls=mod.ATTR_CALLS, return_types=mod.RETURN_TYPES,
+            extra_edges=mod.EXTRA_EDGES,
+            jax_surface_heads=mod.JAX_SURFACE_HEADS,
+            jax_surface_calls=mod.JAX_SURFACE_CALLS,
+            blocking_calls=mod.BLOCKING_CALLS,
+            compile_funcs=mod.COMPILE_FUNCS,
+            gil_wedge_calls=mod.GIL_WEDGE_CALLS,
+            gil_wedge_home=mod.GIL_WEDGE_HOME,
+            atomic_ok=mod.ATOMIC_OK)
+
+
+def load_default_registry(root: str | Path = ".") -> Registry:
+    mod = load_registry_module(
+        "_threadlint_thread_registry",
+        Path(root) / "mpitest_tpu" / "utils" / "thread_registry.py",
+        register=True)
+    return Registry.from_module(mod)
+
+
+# -------------------------------------------------------- suppressions
+
+def _suppressions(src: str) -> dict[int, tuple[set, Optional[str]]]:
+    out: dict[int, tuple[set, Optional[str]]] = {}
+    for i, line in enumerate(src.splitlines(), 1):
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            ids = {t.strip() for t in m.group("ids").split(",")
+                   if t.strip()}
+            out[i] = (ids, m.group("reason"))
+    return out
+
+
+def apply_suppressions(src: str, findings: list, path: str) -> list:
+    """Drop findings suppressed on their own line (or the line above);
+    a directive without a reason becomes TL000 and mutes nothing."""
+    sup = _suppressions(src)
+    out = []
+    for i, (ids, reason) in sup.items():
+        if reason is None:
+            out.append(Finding(
+                "TL000", path, i,
+                f"suppression of {','.join(sorted(ids))} has no reason; "
+                "write `# threadlint: disable=<ID> -- <why>`"))
+    for f in findings:
+        killed = False
+        for ln in (f.line, f.line - 1):
+            entry = sup.get(ln)
+            if entry and f.rule in entry[0] and entry[1]:
+                killed = True
+                break
+        if not killed:
+            out.append(f)
+    return out
+
+
+# ------------------------------------------------------- entry points
+
+def lint_files(files: dict[str, str], registry: Registry,
+               check_vocab: bool = False) -> list:
+    """Analyze a {relative path: source} mapping against a registry.
+    ``check_vocab=True`` additionally pins the registry against the
+    program (roots/locks that no longer exist are findings) — on for
+    full-repo runs, off for partial fixture runs."""
+    from tools.threadlint.engine import Program
+    from tools.threadlint.rules import run_rules
+
+    program = Program(registry)
+    findings: list[Finding] = []
+    for path in sorted(files):
+        try:
+            program.add_module(path, files[path])
+        except SyntaxError as e:
+            findings.append(Finding(
+                "TL999", path, e.lineno or 0, f"syntax error: {e.msg}"))
+    program.analyze()
+    sup = {path: _suppressions(src) for path, src in files.items()}
+    findings.extend(run_rules(program, registry, check_vocab,
+                              suppressions=sup))
+    by_path: dict[str, list] = {}
+    for f in findings:
+        by_path.setdefault(f.path, []).append(f)
+    out: list[Finding] = []
+    for path, fs in sorted(by_path.items()):
+        src = files.get(path)
+        out.extend(apply_suppressions(src, fs, path) if src is not None
+                   else fs)
+    # suppression directives in clean files still need the TL000 scan
+    for path in sorted(set(files) - set(by_path)):
+        out.extend(apply_suppressions(files[path], [], path))
+    return sorted(out, key=lambda f: (f.path, f.line, f.rule))
+
+
+def lint_source(src: str, path: str, registry: Registry) -> list:
+    """Single-snippet convenience for the test harness."""
+    return lint_files({path: src}, registry)
+
+
+def lint_repo(root: str | Path = ".",
+              targets: Iterable[str] = DEFAULT_TARGETS) -> list:
+    root = Path(root)
+    registry = load_default_registry(root)
+    files = {str(f.relative_to(root)): f.read_text()
+             for f in iter_target_files(root, targets)}
+    return lint_files(files, registry, check_vocab=True)

@@ -1,0 +1,170 @@
+"""CLI: ``python -m tools.threadlint [targets...]``.
+
+Exit 0 when clean, 1 when findings survive suppression.  ``--selftest``
+runs every rule against its planted bad fixture (``make
+threadlint-fixtures``): a rule that stops firing is a broken rule, and
+the cheapest place to learn that is the lint job itself.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from tools.threadlint import (LINT_VERSION, RULES, Registry, lint_files,
+                              lint_repo)
+
+# --------------------------------------------------------- selftest
+
+#: One deliberately-bad fixture per rule; the selftest asserts the rule
+#: FIRES (fixture drift fails fast).  Each fixture is a tiny standalone
+#: module linted against a matching synthetic registry.
+_BAD_FIXTURES: dict[str, str] = {
+    "TL000": (
+        "import threading\n"
+        "L = threading.Lock()  # threadlint: disable=TL011\n"
+    ),
+    "TL001": (
+        "import threading\n"
+        "import jax\n"
+        "def work():\n"
+        "    jax.device_put([1, 2])\n"
+        "def start():\n"
+        "    threading.Thread(target=work).start()\n"
+    ),
+    "TL002": (
+        "import threading\n"
+        "A = threading.Lock()\n"
+        "B = threading.Lock()\n"
+        "def one():\n"
+        "    with A:\n"
+        "        with B:\n"
+        "            pass\n"
+        "def other():\n"
+        "    with B:\n"
+        "        with A:\n"
+        "            pass\n"
+    ),
+    "TL003": (
+        "import threading\n"
+        "import os\n"
+        "L = threading.Lock()\n"
+        "def flush(fd):\n"
+        "    with L:\n"
+        "        os.fsync(fd)\n"
+    ),
+    "TL004": (
+        "import threading\n"
+        "class Cell:\n"
+        "    def writer_a(self):\n"
+        "        self.value = 1\n"
+        "    def writer_b(self):\n"
+        "        self.value = 2\n"
+        "def start(c):\n"
+        "    threading.Thread(target=c.writer_a).start()\n"
+        "    threading.Thread(target=c.writer_b).start()\n"
+    ),
+    "TL005": (
+        "def peek(client):\n"
+        "    return client.get_topology_desc()\n"
+    ),
+    "TL010": (
+        "import threading\n"
+        "def anon():\n"
+        "    pass\n"
+        "def start():\n"
+        "    threading.Thread(target=anon).start()\n"
+    ),
+    "TL011": (
+        "import threading\n"
+        "STRAY = threading.Lock()\n"
+    ),
+}
+
+
+def _fixture_registry(rule: str) -> Registry:
+    """The minimal vocabulary each bad fixture lints against."""
+    from tools.threadlint import Lock, Root
+    mod = "fixture_" + rule.lower()
+    if rule == "TL001":
+        return Registry(roots=[Root("bad-root", "thread",
+                                    f"{mod}.work", False)])
+    if rule in ("TL002", "TL003"):
+        locks = [Lock("a", 10, f"{mod}.A"), Lock("b", 20, f"{mod}.B"),
+                 Lock("l", 10, f"{mod}.L")]
+        roots = [Root("r-one", "thread", f"{mod}.one", False),
+                 Root("r-other", "thread", f"{mod}.other", False),
+                 Root("r-flush", "thread", f"{mod}.flush", False)]
+        return Registry(roots=roots, locks=locks,
+                        blocking_calls={"os.fsync": "fsync"})
+    if rule == "TL004":
+        return Registry(roots=[
+            Root("wa", "thread", f"{mod}.Cell.writer_a", False),
+            Root("wb", "thread", f"{mod}.Cell.writer_b", False)])
+    if rule == "TL005":
+        return Registry(gil_wedge_calls=("get_topology_desc",))
+    if rule == "TL000":
+        return Registry(locks=[Lock("l", 10, f"{mod}.L")])
+    return Registry()   # TL010 / TL011: empty vocabulary
+
+
+def selftest() -> int:
+    failed = []
+    for rule, src in sorted(_BAD_FIXTURES.items()):
+        path = f"fixture_{rule.lower()}.py"
+        findings = lint_files({path: src}, _fixture_registry(rule))
+        fired = sorted({f.rule for f in findings})
+        if rule not in fired:
+            failed.append((rule, findings))
+        print(f"threadlint selftest {rule}: "
+              f"{'fires' if rule in fired else 'SILENT'} "
+              f"({len(findings)} finding(s): {', '.join(fired) or '-'})")
+    if failed:
+        for rule, findings in failed:
+            print(f"FAIL: {rule} did not fire on its bad fixture",
+                  file=sys.stderr)
+            for f in findings:
+                print("  " + f.render(), file=sys.stderr)
+        return 1
+    print(f"threadlint selftest: all {len(_BAD_FIXTURES)} rules fire "
+          f"({LINT_VERSION})")
+    return 0
+
+
+# -------------------------------------------------------------- main
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.threadlint",
+        description="interprocedural concurrency lint (stdlib-only)")
+    ap.add_argument("targets", nargs="*", default=None,
+                    help="files/dirs relative to --root "
+                         "(default: the registered lint targets)")
+    ap.add_argument("--root", default=".", help="repo root")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--selftest", action="store_true",
+                    help="assert every rule fires on its bad fixture")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid, doc in sorted(RULES.items()):
+            print(f"{rid}  {doc}")
+        return 0
+    if args.selftest:
+        return selftest()
+
+    root = Path(args.root)
+    if args.targets:
+        findings = lint_repo(root, targets=args.targets)
+    else:
+        findings = lint_repo(root)
+    for f in findings:
+        print(f.render())
+    print(f"threadlint: {len(findings)} finding(s), "
+          f"{len(RULES)} rules ({LINT_VERSION})", file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

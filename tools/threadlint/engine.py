@@ -1,0 +1,747 @@
+"""threadlint's program model: qualnames, call resolution, lock scopes.
+
+Pure ``ast`` over the lint targets — the analyzer never imports the
+package under lint (sortlint's contract).  Two passes:
+
+* **collect** — every module yields its import map, function defs
+  (module-qualified, nested defs joined with dots:
+  ``mpitest_tpu.models.ingest.stream_to_mesh.parse_chunks``), class
+  method tables, lock creation sites and handler classes;
+* **analyze** — every function body is walked once, outer functions
+  before their nested defs (closures consult enclosing local scopes),
+  tracking the ``with``-lock stack per statement and recording calls,
+  lock acquisitions, attribute writes and JAX/blocking/GIL-wedge
+  surface touches, each stamped with the locks held at that point.
+
+Method calls resolve by receiver type: ``self`` binds to the enclosing
+class, local variables type from ``x = ClassName(...)`` / registered
+factory returns, and object attributes type from same-class
+``self.a = ClassName(...)`` assignments plus the registry's explicit
+``RECEIVER_TYPES`` alias table.  Constructor-injected callbacks ride
+``ATTR_CALLS``; dynamic observer fan-out rides ``EXTRA_EDGES``.
+Anything unresolvable stays unresolved — the analysis is conservative
+by construction, and the vocabulary rules (TL010/TL011) keep the parts
+that matter explicit.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Optional
+
+#: Tails of base-class names that make a ClassDef a request handler —
+#: its ``handle``/``do_*`` methods run on server-spawned threads.
+HANDLER_BASE_TAILS = (
+    "BaseRequestHandler", "StreamRequestHandler",
+    "DatagramRequestHandler", "BaseHTTPRequestHandler",
+    "SimpleHTTPRequestHandler",
+)
+
+#: Pseudo-type assigned to ThreadPoolExecutor instances so ``.submit``
+#: sites are recognizable.
+POOL_TYPE = "@pool"
+
+
+@dataclass
+class CallSite:
+    targets: tuple          # resolved callee qualnames (possibly empty)
+    chain: str              # syntactic dotted chain ("" when exotic)
+    tail: str               # last segment of the callee expression
+    line: int
+    held: frozenset         # lock sites held locally at the call
+
+
+@dataclass
+class LockUse:
+    site: str               # canonical lock site
+    line: int
+    held: frozenset         # locks already held (outer withs) locally
+
+
+@dataclass
+class AttrWrite:
+    site: str               # "module.Class.attr" or "module.NAME"
+    line: int
+    held: frozenset
+
+
+@dataclass
+class Touch:
+    label: str
+    line: int
+    held: frozenset
+
+
+@dataclass
+class ThreadSite:
+    entry: Optional[str]    # resolved target qualname (None: opaque)
+    line: int
+    path: str
+    desc: str               # human description of the target expr
+
+
+@dataclass
+class PoolSite:
+    line: int
+    path: str
+    prefix: Optional[str]   # thread_name_prefix literal (None: absent)
+
+
+@dataclass
+class SubmitSite:
+    entry: Optional[str]
+    line: int
+    path: str
+    desc: str
+
+
+@dataclass
+class SignalSite:
+    entry: Optional[str]
+    line: int
+    path: str
+    desc: str
+
+
+@dataclass
+class HandlerEntry:
+    entry: str              # qualname of the handle/do_* method
+    line: int
+    path: str
+
+
+@dataclass
+class LockCreation:
+    site: Optional[str]     # None when the lock has no nameable site
+    line: int
+    path: str
+    kind: str               # Lock | RLock | Condition
+
+
+@dataclass
+class FunctionInfo:
+    qual: str
+    path: str
+    line: int
+    cls: Optional[str]          # enclosing class qualname
+    parent: Optional[str]       # enclosing function qualname
+    is_init: bool
+    node: ast.AST = field(repr=False, default=None)
+    calls: list = field(default_factory=list)
+    acquires: list = field(default_factory=list)
+    writes: list = field(default_factory=list)
+    jax: list = field(default_factory=list)
+    blocking: list = field(default_factory=list)
+    wedge: list = field(default_factory=list)
+    # local name environments, consulted by nested defs
+    var_types: dict = field(default_factory=dict)
+    var_locks: dict = field(default_factory=dict)
+    # locals bound to a constructor call IN THIS function: attribute
+    # writes through them hit a fresh, thread-confined object (Eraser
+    # first-thread discipline), so TL004 skips them
+    fresh_locals: set = field(default_factory=set)
+
+
+class Program:
+    """The whole-target model the rules run over."""
+
+    def __init__(self, registry) -> None:
+        self.registry = registry
+        self.functions: dict[str, FunctionInfo] = {}
+        self.classes: dict[str, dict] = {}   # qual -> {method -> fnqual}
+        self.class_attr_types: dict[str, str] = {}   # "M.C.attr" -> cls
+        self.class_attr_locks: dict[str, str] = {}   # "M.C.attr" -> site
+        self.module_locks: dict[str, str] = {}       # "M.NAME" -> site
+        self.lock_aliases: dict[str, str] = dict(registry.lock_aliases)
+        self.lock_creations: list[LockCreation] = []
+        self.thread_sites: list[ThreadSite] = []
+        self.pool_sites: list[PoolSite] = []
+        self.submit_sites: list[SubmitSite] = []
+        self.signal_sites: list[SignalSite] = []
+        self.handler_entries: list[HandlerEntry] = []
+        self.imports: dict[str, dict[str, str]] = {}  # module -> name map
+        self._order: list[str] = []                   # analysis order
+
+    # -- construction -------------------------------------------------
+    def add_module(self, path: str, src: str) -> None:
+        module = _module_name(path)
+        tree = ast.parse(src, filename=path)
+        self.imports.setdefault(module, {})
+        _Collector(self, path, module).visit(tree)
+
+    def analyze(self) -> None:
+        for qual in self._order:
+            _analyze_function(self, self.functions[qual])
+
+    # -- lock canonicalization ---------------------------------------
+    def canon_lock(self, site: str) -> str:
+        seen = set()
+        while site in self.lock_aliases and site not in seen:
+            seen.add(site)
+            site = self.lock_aliases[site]
+        return site
+
+
+def _module_name(path: str) -> str:
+    p = path.replace("\\", "/")
+    if p.endswith("/__init__.py"):
+        p = p[: -len("/__init__.py")]
+    elif p.endswith(".py"):
+        p = p[:-3]
+    return p.replace("/", ".")
+
+
+def _attr_chain(node: ast.AST) -> str:
+    """Dotted chain for Name/Attribute trees; "" when any link is
+    exotic (a call, a subscript...)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _tail(node: ast.AST) -> str:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+# ---------------------------------------------------------------- pass A
+
+class _Collector(ast.NodeVisitor):
+    """Collects defs/classes/imports/module-level lock sites and
+    queues every function for the analysis pass."""
+
+    def __init__(self, program: Program, path: str, module: str) -> None:
+        self.p = program
+        self.path = path
+        self.module = module
+        self.scope: list[tuple[str, str]] = []  # (kind, qual)
+
+    # scope helpers
+    def _qual(self, name: str) -> str:
+        return (self.scope[-1][1] + "." + name) if self.scope \
+            else (self.module + "." + name)
+
+    def _enclosing_class(self) -> Optional[str]:
+        for kind, qual in reversed(self.scope):
+            if kind == "class":
+                return qual
+        return None
+
+    def _enclosing_func(self) -> Optional[str]:
+        for kind, qual in reversed(self.scope):
+            if kind == "func":
+                return qual
+        return None
+
+    # imports (collected module-wide wherever they appear)
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            self.p.imports[self.module][a.asname or
+                                        a.name.split(".")[0]] = a.name
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module and node.level == 0:
+            for a in node.names:
+                self.p.imports[self.module][a.asname or a.name] = \
+                    node.module + "." + a.name
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        qual = self._qual(node.name)
+        self.p.classes.setdefault(qual, {})
+        # handler classes: every handle/do_* method is a thread entry
+        is_handler = any(_tail(b) in HANDLER_BASE_TAILS
+                         for b in node.bases)
+        for stmt in node.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.p.classes[qual][stmt.name] = qual + "." + stmt.name
+                if is_handler and (stmt.name == "handle"
+                                   or stmt.name.startswith("do_")):
+                    self.p.handler_entries.append(HandlerEntry(
+                        qual + "." + stmt.name, stmt.lineno, self.path))
+            elif isinstance(stmt, ast.Assign):
+                # class-body lock: `_flush_lock = threading.Lock()`
+                kind = _lock_kind(stmt.value, self.p.imports[self.module])
+                if kind and len(stmt.targets) == 1 and \
+                        isinstance(stmt.targets[0], ast.Name):
+                    site = qual + "." + stmt.targets[0].id
+                    self.p.class_attr_locks[site] = site
+                    self.p.lock_creations.append(LockCreation(
+                        site, stmt.lineno, self.path, kind))
+        self.scope.append(("class", qual))
+        self.generic_visit(node)
+        self.scope.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._def(node)
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._def(node)
+
+    def _def(self, node) -> None:
+        qual = self._qual(node.name)
+        fi = FunctionInfo(
+            qual=qual, path=self.path, line=node.lineno,
+            cls=self._enclosing_class(), parent=self._enclosing_func(),
+            is_init=node.name in ("__init__", "__post_init__"),
+            node=node)
+        self.p.functions[qual] = fi
+        self.p._order.append(qual)   # outer before nested (visit order)
+        self.scope.append(("func", qual))
+        self.generic_visit(node)
+        self.scope.pop()
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        # module-level lock: `_LOAD_LOCK = threading.Lock()`
+        if not self.scope:
+            kind = _lock_kind(node.value, self.p.imports[self.module])
+            if kind and len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name):
+                site = self.module + "." + node.targets[0].id
+                self.p.module_locks[site] = site
+                self.p.lock_creations.append(LockCreation(
+                    site, node.lineno, self.path, kind))
+        self.generic_visit(node)
+
+
+def _lock_kind(value: ast.AST,
+               imports: dict[str, str]) -> Optional[str]:
+    """"Lock"/"RLock"/"Condition" when ``value`` creates one."""
+    if not isinstance(value, ast.Call):
+        return None
+    chain = _attr_chain(value.func)
+    for kind in ("Lock", "RLock", "Condition"):
+        if chain == "threading." + kind:
+            return kind
+        if chain == kind and imports.get(kind) == "threading." + kind:
+            return kind
+    return None
+
+
+# ---------------------------------------------------------------- pass B
+
+class _FnCtx:
+    """Resolution context for one function: scope chain + envs."""
+
+    def __init__(self, p: Program, fi: FunctionInfo) -> None:
+        self.p = p
+        self.fi = fi
+        self.module = fi.qual.rsplit(".", 1)[0]
+        # the module is the qual prefix up to the first def/class name;
+        # recover it by stripping known function/class suffixes
+        q = fi.qual
+        while True:
+            head = q.rsplit(".", 1)[0]
+            if head in p.functions or head in p.classes:
+                q = head
+                continue
+            break
+        self.module = q.rsplit(".", 1)[0]
+        self.imports = p.imports.get(self.module, {})
+        self.globals_decl: set[str] = set()
+
+    # -- scope-chained lookups ---------------------------------------
+    def _chain(self):
+        fi = self.fi
+        while fi is not None:
+            yield fi
+            fi = self.p.functions.get(fi.parent) if fi.parent else None
+
+    def local_type(self, name: str) -> Optional[str]:
+        for fi in self._chain():
+            if name in fi.var_types:
+                return fi.var_types[name]
+        return None
+
+    def local_lock(self, name: str) -> Optional[str]:
+        for fi in self._chain():
+            if name in fi.var_locks:
+                return fi.var_locks[name]
+        return None
+
+    def resolve_name(self, name: str) -> Optional[str]:
+        """A bare name used as a callable/target: nested defs of any
+        enclosing function, then module defs/classes, then imports."""
+        for fi in self._chain():
+            cand = fi.qual + "." + name
+            if cand in self.p.functions:
+                return cand
+        for cand in (self.module + "." + name,):
+            if cand in self.p.functions or cand in self.p.classes:
+                return cand
+        imp = self.imports.get(name)
+        if imp:
+            return imp
+        return None
+
+    # -- typing -------------------------------------------------------
+    def type_of(self, node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Name):
+            if node.id == "self" and self.fi.cls:
+                return self.fi.cls
+            t = self.local_type(node.id)
+            if t:
+                return t
+            imp = self.imports.get(node.id)
+            if imp and imp in self.p.classes:
+                return imp
+            return None
+        if isinstance(node, ast.Attribute):
+            base_t = self.type_of(node.value)
+            if base_t:
+                return self.attr_type(base_t, node.attr)
+            return None
+        if isinstance(node, ast.Call):
+            # class construction types directly, with or without an
+            # explicit __init__ (stdlib subclasses often inherit it)
+            f = node.func
+            if isinstance(f, ast.Name):
+                t = self.resolve_name(f.id)
+                if t in self.p.classes:
+                    return t
+            elif isinstance(f, ast.Attribute) and \
+                    isinstance(f.value, ast.Name):
+                imp = self.imports.get(f.value.id)
+                if imp and imp + "." + f.attr in self.p.classes:
+                    return imp + "." + f.attr
+            for t in self.resolve_call_targets(f):
+                rt = self.p.registry.return_types.get(t)
+                if rt:
+                    return rt
+            if _tail(f) == "ThreadPoolExecutor":
+                return POOL_TYPE
+            return None
+        return None
+
+    def attr_type(self, cls: str, attr: str) -> Optional[str]:
+        key = cls + "." + attr
+        return self.p.registry.receiver_types.get(key) or \
+            self.p.class_attr_types.get(key)
+
+    def is_constructor_call(self, node: ast.AST) -> bool:
+        """True when ``node`` constructs a program class directly (NOT
+        a factory return — factories may hand out shared singletons)."""
+        if not isinstance(node, ast.Call):
+            return False
+        f = node.func
+        if isinstance(f, ast.Name):
+            return self.resolve_name(f.id) in self.p.classes
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            imp = self.imports.get(f.value.id)
+            return bool(imp) and imp + "." + f.attr in self.p.classes
+        return False
+
+    # -- lock resolution ----------------------------------------------
+    def lock_of(self, node: ast.AST) -> Optional[str]:
+        site = None
+        if isinstance(node, ast.Name):
+            site = self.local_lock(node.id) or \
+                self.p.module_locks.get(self.module + "." + node.id)
+        elif isinstance(node, ast.Attribute):
+            base_t = self.type_of(node.value)
+            if base_t:
+                key = base_t + "." + node.attr
+                if key in self.p.class_attr_locks or \
+                        key in self.p.lock_aliases or \
+                        key in self.p.registry.lock_sites:
+                    site = key
+        return self.p.canon_lock(site) if site else None
+
+    # -- call resolution ----------------------------------------------
+    def resolve_call_targets(self, func: ast.AST) -> tuple:
+        """Resolved qualnames a call on ``func`` may run."""
+        if isinstance(func, ast.Name):
+            t = self.resolve_name(func.id)
+            if t is None:
+                return ()
+            if t in self.p.classes:
+                init = self.p.classes[t].get("__init__")
+                return (init,) if init else ()
+            return (t,) if t in self.p.functions else ()
+        if isinstance(func, ast.Attribute):
+            # module-qualified: `flight_recorder.get(...)`
+            if isinstance(func.value, ast.Name):
+                imp = self.imports.get(func.value.id)
+                if imp:
+                    cand = imp + "." + func.attr
+                    if cand in self.p.functions:
+                        return (cand,)
+                    if cand in self.p.classes:
+                        init = self.p.classes[cand].get("__init__")
+                        return (init,) if init else ()
+            base_t = self.type_of(func.value)
+            if base_t:
+                key = base_t + "." + func.attr
+                if key in self.p.functions:
+                    return (key,)
+                cb = self.p.registry.attr_calls.get(key)
+                if cb:
+                    return tuple(cb)
+        return ()
+
+    def resolve_target_ref(self, node: ast.AST) -> tuple:
+        """Resolve a function REFERENCE (thread target, submit arg,
+        signal handler) to (qualname-or-None, description).  Unlike a
+        call, an unresolved method reference on a typed receiver still
+        yields the syntactic ``Class.attr`` name (stdlib entries like
+        ``serve_forever`` register that way)."""
+        desc = _attr_chain(node) or ast.dump(node)[:40]
+        if isinstance(node, ast.Name):
+            return self.resolve_name(node.id), desc
+        if isinstance(node, ast.Attribute):
+            if isinstance(node.value, ast.Name):
+                imp = self.imports.get(node.value.id)
+                if imp:
+                    return imp + "." + node.attr, desc
+            base_t = self.type_of(node.value)
+            if base_t:
+                return base_t + "." + node.attr, desc
+        return None, desc
+
+
+def _analyze_function(p: Program, fi: FunctionInfo) -> None:
+    ctx = _FnCtx(p, fi)
+    node = fi.node
+    # phase 0: parameter defaults carry types/locks into the local env
+    # (the closure-capture idiom `def _prewarm(cache=self.cache):`)
+    a = node.args
+    pos = list(getattr(a, "posonlyargs", [])) + list(a.args)
+    for arg, d in zip(pos[len(pos) - len(a.defaults):], a.defaults):
+        _bind_default(ctx, fi, arg.arg, d)
+    for arg, d in zip(a.kwonlyargs, a.kw_defaults):
+        if d is not None:
+            _bind_default(ctx, fi, arg.arg, d)
+    # phase 1: local env (assignments + global decls), no nested defs
+    for stmt in _iter_stmts(node.body):
+        if isinstance(stmt, ast.Global):
+            ctx.globals_decl.update(stmt.names)
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+            tgt = stmt.targets[0]
+            kind = _lock_kind(stmt.value, ctx.imports)
+            if isinstance(tgt, ast.Name):
+                if kind:
+                    site = fi.qual + "." + tgt.id
+                    fi.var_locks[tgt.id] = site
+                    p.lock_creations.append(LockCreation(
+                        site, stmt.lineno, fi.path, kind))
+                    if kind == "Condition" and \
+                            isinstance(stmt.value, ast.Call) and \
+                            stmt.value.args:
+                        inner = ctx.lock_of(stmt.value.args[0])
+                        if inner:
+                            p.lock_aliases[site] = inner
+                else:
+                    t = ctx.type_of(stmt.value)
+                    if t:
+                        fi.var_types[tgt.id] = t
+                        if ctx.is_constructor_call(stmt.value):
+                            fi.fresh_locals.add(tgt.id)
+            elif isinstance(tgt, ast.Attribute) and \
+                    isinstance(tgt.value, ast.Name) and \
+                    tgt.value.id == "self" and fi.cls:
+                site = fi.cls + "." + tgt.attr
+                if kind:
+                    p.class_attr_locks[site] = site
+                    p.lock_creations.append(LockCreation(
+                        site, stmt.lineno, fi.path, kind))
+                    if kind == "Condition" and \
+                            isinstance(stmt.value, ast.Call) and \
+                            stmt.value.args:
+                        inner = ctx.lock_of(stmt.value.args[0])
+                        if inner:
+                            p.lock_aliases[site] = inner
+                else:
+                    t = ctx.type_of(stmt.value)
+                    if t and site not in p.class_attr_types:
+                        p.class_attr_types[site] = t
+        # `with ThreadPoolExecutor(...) as ex:` pool typing
+        if isinstance(stmt, ast.With):
+            for item in stmt.items:
+                if isinstance(item.optional_vars, ast.Name):
+                    t = ctx.type_of(item.context_expr)
+                    if t:
+                        fi.var_types[item.optional_vars.id] = t
+    # phase 2: the lock-scoped walk
+    _walk_block(p, ctx, fi, node.body, frozenset())
+
+
+def _bind_default(ctx: _FnCtx, fi: FunctionInfo, name: str,
+                  default: ast.AST) -> None:
+    t = ctx.type_of(default)
+    if t:
+        fi.var_types[name] = t
+        return
+    lk = ctx.lock_of(default)
+    if lk:
+        fi.var_locks[name] = lk
+
+
+def _iter_stmts(body):
+    """Every statement in a block, recursively, EXCLUDING nested
+    def/class bodies (they are separate FunctionInfos)."""
+    for stmt in body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        yield stmt
+        for attr in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, attr, None)
+            if isinstance(sub, list):
+                yield from _iter_stmts(sub)
+        for h in getattr(stmt, "handlers", []) or []:
+            yield from _iter_stmts(h.body)
+
+
+def _walk_block(p: Program, ctx: _FnCtx, fi: FunctionInfo,
+                body, held: frozenset) -> None:
+    for stmt in body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        if isinstance(stmt, ast.With):
+            inner = set(held)
+            for item in stmt.items:
+                _scan_exprs(p, ctx, fi, item.context_expr,
+                            frozenset(inner))
+                lock = ctx.lock_of(item.context_expr)
+                if lock:
+                    fi.acquires.append(LockUse(
+                        lock, stmt.lineno, frozenset(inner)))
+                    inner.add(lock)
+            _walk_block(p, ctx, fi, stmt.body, frozenset(inner))
+            continue
+        # expressions owned by this statement line
+        for expr in _stmt_exprs(stmt):
+            _scan_exprs(p, ctx, fi, expr, held)
+        _record_writes(p, ctx, fi, stmt, held)
+        for attr in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, attr, None)
+            if isinstance(sub, list):
+                _walk_block(p, ctx, fi, sub, held)
+        for h in getattr(stmt, "handlers", []) or []:
+            _walk_block(p, ctx, fi, h.body, held)
+
+
+def _stmt_exprs(stmt):
+    """The expression trees evaluated AT this statement (child block
+    statements are walked separately)."""
+    for f in ast.iter_fields(stmt):
+        name, value = f
+        if name in ("body", "orelse", "finalbody", "handlers"):
+            continue
+        if isinstance(value, ast.expr):
+            yield value
+        elif isinstance(value, list):
+            for v in value:
+                if isinstance(v, ast.expr):
+                    yield v
+
+
+def _record_writes(p: Program, ctx: _FnCtx, fi: FunctionInfo,
+                   stmt, held: frozenset) -> None:
+    targets = []
+    if isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    for tgt in targets:
+        for node in ast.walk(tgt) if isinstance(tgt, ast.Tuple) \
+                else [tgt]:
+            if isinstance(node, ast.Attribute):
+                # writes through a same-function constructor-fresh
+                # local hit a thread-confined object: not shared state
+                if isinstance(node.value, ast.Name) and \
+                        node.value.id in fi.fresh_locals:
+                    continue
+                base_t = ctx.type_of(node.value)
+                if base_t and base_t != POOL_TYPE:
+                    fi.writes.append(AttrWrite(
+                        base_t + "." + node.attr, stmt.lineno, held))
+            elif isinstance(node, ast.Name) and \
+                    node.id in ctx.globals_decl:
+                fi.writes.append(AttrWrite(
+                    ctx.module + "." + node.id, stmt.lineno, held))
+
+
+def _scan_exprs(p: Program, ctx: _FnCtx, fi: FunctionInfo,
+                expr: ast.AST, held: frozenset) -> None:
+    """Record calls/surface touches in one expression tree (lambdas
+    inline: a deferred body is attributed to the defining function)."""
+    reg = p.registry
+    for node in ast.walk(expr):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        tail = _tail(node.func)
+        targets = ctx.resolve_call_targets(node.func)
+        fi.calls.append(CallSite(targets, chain, tail,
+                                 node.lineno, held))
+        head = chain.split(".", 1)[0] if chain else ""
+        # JAX surface (TL001)
+        if head in reg.jax_surface_heads or \
+                tail in reg.jax_surface_calls or \
+                any(t in reg.compile_funcs for t in targets):
+            fi.jax.append(Touch(chain or tail, node.lineno, held))
+        # blocking surface (TL003)
+        label = reg.blocking_calls.get(chain) or \
+            (reg.blocking_calls.get("." + tail)
+             if isinstance(node.func, ast.Attribute) else None)
+        if label is None and any(t in reg.compile_funcs
+                                 for t in targets):
+            label = "XLA compile"
+        if label is None and chain == "jax.jit":
+            label = "XLA compile"
+        if label is not None:
+            fi.blocking.append(Touch(label, node.lineno, held))
+        # GIL-wedge surface (TL005)
+        if tail in reg.gil_wedge_calls:
+            fi.wedge.append(Touch(chain or tail, node.lineno, held))
+        # thread/pool/signal vocabulary sites (TL010)
+        _record_vocab_sites(p, ctx, fi, node, chain, tail)
+
+
+def _record_vocab_sites(p: Program, ctx: _FnCtx, fi: FunctionInfo,
+                        node: ast.Call, chain: str, tail: str) -> None:
+    imports = ctx.imports
+    if tail == "Thread" and (chain in ("threading.Thread", "Thread")):
+        if chain == "Thread" and \
+                imports.get("Thread") != "threading.Thread":
+            return
+        target = next((kw.value for kw in node.keywords
+                       if kw.arg == "target"), None)
+        if target is None:
+            p.thread_sites.append(ThreadSite(
+                None, node.lineno, fi.path, "Thread without target="))
+            return
+        entry, desc = ctx.resolve_target_ref(target)
+        p.thread_sites.append(ThreadSite(
+            entry, node.lineno, fi.path, desc))
+    elif tail == "ThreadPoolExecutor":
+        if chain not in ("ThreadPoolExecutor",
+                         "concurrent.futures.ThreadPoolExecutor",
+                         "futures.ThreadPoolExecutor"):
+            return
+        prefix = next(
+            (kw.value.value for kw in node.keywords
+             if kw.arg == "thread_name_prefix"
+             and isinstance(kw.value, ast.Constant)), None)
+        p.pool_sites.append(PoolSite(node.lineno, fi.path, prefix))
+    elif tail == "submit" and isinstance(node.func, ast.Attribute):
+        if ctx.type_of(node.func.value) == POOL_TYPE and node.args:
+            entry, desc = ctx.resolve_target_ref(node.args[0])
+            p.submit_sites.append(SubmitSite(
+                entry, node.lineno, fi.path, desc))
+    elif chain == "signal.signal" and len(node.args) == 2:
+        handler = node.args[1]
+        # SIG_IGN / SIG_DFL / literals are not code entries
+        if _tail(handler) in ("SIG_IGN", "SIG_DFL"):
+            return
+        entry, desc = ctx.resolve_target_ref(handler)
+        p.signal_sites.append(SignalSite(
+            entry, node.lineno, fi.path, desc))

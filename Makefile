@@ -77,6 +77,13 @@
 #                  spawned server serves a payload_bytes request and
 #                  an over-admission request (via the spill tier) each
 #                  bit-identical to the solo in-memory oracle.
+#   make spillperf-selftest — the disk-speed gate (ISSUE 20): on a
+#                  simulated slow disk (SORT_SPILL_THROTTLE_MBPS token
+#                  bucket) an external sort over compressed SORTRUN2
+#                  runs must run >= 1.5x the raw-run baseline (both
+#                  legs bit-identical to np.sort AND the in-memory
+#                  sort), and the final merge's measured read-ahead/
+#                  write-behind disk/compute overlap must be >= 0.5.
 #   make durability-selftest — the crash-durability gate (ISSUE 18):
 #                  a real spawned server is SIGKILLed mid-external-sort
 #                  (merge wedged by an armed stall, every spill run
@@ -122,7 +129,8 @@ PYTHON ?= python3
 .PHONY: test native native-encode chip-test telemetry-selftest \
     ingest-selftest fault-selftest multichip-selftest serve-selftest \
     chaos-serve-selftest planner-selftest external-selftest \
-    durability-selftest doctor-selftest localsort-selftest lint \
+    spillperf-selftest durability-selftest doctor-selftest \
+    localsort-selftest lint \
     threadlint-fixtures cwarn-check typecheck tidy-check knob-docs \
     sanitize-selftest bench-history clean
 
@@ -267,6 +275,24 @@ external-selftest:
 	$(PYTHON) -m mpitest_tpu.report --check --require-registered-spans \
 	    $(EXTERNAL_TMP)/trace.jsonl
 
+# The disk-speed gate (ISSUE 20) — see bench/spillperf_selftest.py.
+# On a simulated slow disk (the SORT_SPILL_THROTTLE_MBPS token bucket),
+# external sort over compressed SORTRUN2 runs vs the raw baseline:
+# both legs bit-identical to np.sort AND the in-memory sort, the
+# compressed leg >= 1.5x faster at the disk-bound budget, and the
+# final merge's measured read-ahead/write-behind disk/compute overlap
+# >= 0.5.  Builds the native codec first (the gate measures it; the
+# pure-Python fallback is covered by the unit tests instead).
+SPILLPERF_TMP := /tmp/mpitest_spillperf_selftest
+spillperf-selftest:
+	$(MAKE) -C bench libspillz
+	rm -rf $(SPILLPERF_TMP) && mkdir -p $(SPILLPERF_TMP)
+	JAX_PLATFORMS=cpu \
+	    SORT_TRACE=$(SPILLPERF_TMP)/trace.jsonl \
+	    $(PYTHON) -u bench/spillperf_selftest.py
+	$(PYTHON) -m mpitest_tpu.report --check --require-registered-spans \
+	    $(SPILLPERF_TMP)/trace.jsonl
+
 # The crash-durability gate (ISSUE 18) — see bench/durability_selftest.py.
 # SIGKILL a real server mid-external-sort, restart, retry the same
 # dataset_id: the journaled manifest must turn the crash into a
@@ -398,6 +424,8 @@ cwarn-check:
 	$(CC) $(CWARN) -Icomm/mpi_stub native/minimpi_earlyexit.c
 	$(CC) $(CWARN) -Inative native/encode.c
 	$(CC) $(CWARN) -Inative native/encode_fuzz.c
+	$(CC) $(CWARN) -Inative native/spillz.c
+	$(CC) $(CWARN) -Inative native/spillz_fuzz.c
 	@echo "cwarn-check OK (-Wconversion -Wshadow -Werror clean)"
 
 typecheck:
@@ -472,6 +500,20 @@ sanitize-selftest:
 	for s in $(SAN_SEEDS); do \
 	    ./bench/encode_fuzz $$s 300 > $(SAN_OUT)/encplain_$$s || exit 1; \
 	    cmp $(SAN_OUT)/encasan_$$s $(SAN_OUT)/encplain_$$s || exit 1; \
+	done
+	@echo "== ASan+UBSan: spill block-codec fuzz, corrupt corpora (ISSUE 20) =="
+	rm -f bench/spillz_fuzz
+	$(MAKE) -C bench SANITIZE=address,undefined spillz_fuzz
+	for s in $(SAN_SEEDS); do \
+	    ASAN_OPTIONS="suppressions=$(SAN_SUPP)" \
+	        ./bench/spillz_fuzz $$s 1500 > $(SAN_OUT)/spzasan_$$s || exit 1; \
+	    cat $(SAN_OUT)/spzasan_$$s; \
+	done
+	rm -f bench/spillz_fuzz
+	$(MAKE) -C bench spillz_fuzz
+	for s in $(SAN_SEEDS); do \
+	    ./bench/spillz_fuzz $$s 1500 > $(SAN_OUT)/spzplain_$$s || exit 1; \
+	    cmp $(SAN_OUT)/spzasan_$$s $(SAN_OUT)/spzplain_$$s || exit 1; \
 	done
 	@echo "== ASan+UBSan: MPI backend over the fork-based minimpi runtime =="
 	rm -f bench/comm_selftest_minimpi bench/comm_fuzz_minimpi

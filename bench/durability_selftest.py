@@ -130,6 +130,10 @@ def main() -> int:
         "SORT_SPILL_DIR": str(spill),
         "SORT_RESUME": "auto",
         "SORT_SERVE_BATCH_WINDOW_MS": "0",
+        # ISSUE 20: the whole kill/resume drill runs over COMPRESSED
+        # (SORTRUN2) runs — crash durability must hold for the new
+        # framing, including cross-process resume of .runz journals
+        "SORT_SPILL_COMPRESS": "on",
     }
 
     print(f"kill-resume drill: {N} int32 keys -> {n_runs} journaled "

@@ -255,6 +255,11 @@ def row_main() -> int:
         "merge_passes": res.merge_passes,
         "wall_s": round(dt, 4),
         "local_engine": str(knobs.get("SORT_LOCAL_ENGINE")),
+        # ISSUE 20: compression + async-IO trajectory fields (rows
+        # from older rounds lack them and render "-")
+        "spill_ratio": round(res.spill_ratio, 3),
+        "disk_overlap": round(res.disk_overlap, 3),
+        "spill_compress": str(knobs.get("SORT_SPILL_COMPRESS")),
     }))
     return 0
 

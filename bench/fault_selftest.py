@@ -80,7 +80,7 @@ def main() -> int:
     #: rather than recovery.
     STORE_SITES = ("spill_corrupt", "merge_drop", "spill_torn_write",
                    "spill_bitrot", "spill_enospc", "manifest_torn",
-                   "merge_stall")
+                   "merge_stall", "spill_block_garbage")
 
     print(f"fault grid: {len(faults.SITES)} sites x {{radix, sample}} "
           "— must recover verified (or fail typed: spill_enospc)")
@@ -93,6 +93,11 @@ def main() -> int:
                              "SORT_INGEST_CHUNK": "4096"}
             elif site == "merge_stall":
                 env_extra = {"SORT_FAULT_STALL_MS": "10"}
+            elif site == "spill_block_garbage":
+                # the drill scrambles a SORTRUN2 block header, so the
+                # cell must force compressed runs even when the native
+                # codec library is absent (pure-Python engine)
+                env_extra = {"SORT_SPILL_COMPRESS": "on"}
             reg = faults.FaultRegistry(site, seed=7)
             faults.install(reg)
             tr = Tracer()
@@ -140,6 +145,38 @@ def main() -> int:
                         else "(unexpected OSError)"))
             finally:
                 faults.install(None)
+
+    print("compressed-spill variants (ISSUE 20): the raw-era disk "
+          "faults re-drilled over SORTRUN2 runs")
+    # the generic grid above runs the disk sites under the knob default
+    # — these cells force compression ON so every raw-era corruption
+    # shape is ALSO proven against the compressed framing (checksum
+    # mismatch / sidecar fold / truncated block, all blamed + re-spilled)
+    for site in ("spill_corrupt", "spill_bitrot", "spill_torn_write"):
+        reg = faults.FaultRegistry(site, seed=7)
+        faults.install(reg)
+        tr = Tracer()
+        name = f"{site} x radix (compress=on)"
+        try:
+            from mpitest_tpu.store import external
+
+            with knobs.scoped_env(SORT_SPILL_COMPRESS="on"):
+                got = external.external_sort(
+                    x, algorithm="radix", mesh=mesh, tracer=tr,
+                    budget=1 << 17,
+                    spill_dir=str(spill_dir)).keys
+            exact = bool(np.array_equal(got, ref))
+            fired = reg.injected > 0
+            cell(name, exact and fired,
+                 f"faults={reg.injected} "
+                 f"recoveries={int(tr.counters.get('external_recoveries', 0))}"
+                 + ("" if exact else " WRONG RESULT")
+                 + ("" if fired else " FAULT NEVER FIRED"))
+        except (SortIntegrityError, SortRetryExhausted) as e:
+            cell(name, False,
+                 f"typed error on a transient fault: {type(e).__name__}")
+        finally:
+            faults.install(None)
 
     print("persistent faults: recover via ladder OR fail typed")
     for spec, fallback, expect in (

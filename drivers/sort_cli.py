@@ -151,6 +151,9 @@ def main(argv: list[str] | None = None) -> int:
             # out-of-core external sort (ISSUE 15): inputs above the
             # byte budget spill to runs and k-way merge back
             "SORT_MEM_BUDGET", "SORT_SPILL_DIR", "SORT_MERGE_FANIN",
+            # spill compression + simulated-disk throttle (ISSUE 20):
+            # both are read in the spill hot path, so garbage dies here
+            "SORT_SPILL_COMPRESS", "SORT_SPILL_THROTTLE_MBPS",
             # streaming sentinel (ISSUE 16): the knobs are serve-side
             # but shared tooling (report --doctor thresholds) reads
             # them, so garbage dies here too

@@ -106,6 +106,8 @@ def main(argv: list[str] | None = None) -> int:
             # stream to disk and ride the external sort
             "SORT_SERVE_SPILL", "SORT_SPILL_DIR", "SORT_MEM_BUDGET",
             "SORT_MERGE_FANIN",
+            # spill compression + simulated-disk throttle (ISSUE 20)
+            "SORT_SPILL_COMPRESS", "SORT_SPILL_THROTTLE_MBPS",
             # crash-durable spill tier (ISSUE 18): journaled manifests,
             # kill-resume, the orphan GC sweep, the disk-fault drills
             "SORT_RESUME", "SORT_SPILL_GC_AGE_S", "SORT_FAULT_ENOSPC_AT",

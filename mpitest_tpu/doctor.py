@@ -341,12 +341,24 @@ def _r_spill_bound(ev: dict) -> Finding | None:
     frac = disk / total
     if frac < SPILL_FRACTION_GATE:
         return None
+    evidence = [f"external.run/external.merge: {disk:.3f}s "
+                f"disk vs {comp:.3f}s compute "
+                f"(overlap {ov.get('compute_disk_pct', 0)}%)"]
+    # traces from the async-merge era (ISSUE 20) carry the measured
+    # read-ahead/write-behind concurrency; surface it when present so
+    # the operator can tell "disk-bound AND synchronous" (fixable by
+    # the IO engine) from "disk-bound at full overlap" (buy compression
+    # or a faster disk) — older traces lack the key, behavior unchanged
+    spill_ov = ov.get("spill_disk_overlap")
+    if isinstance(spill_ov, (int, float)):
+        evidence.append(
+            f"final merge read-ahead/write-behind overlap "
+            f"{100 * float(spill_ov):.0f}% "
+            "(SORT_SPILL_COMPRESS shrinks the disk traffic itself)")
     return Finding("spill_bound", "warn",
                    f"disk spill/merge IO is {100 * frac:.0f}% of the "
                    f"compute+IO wall",
-                   evidence=[f"external.run/external.merge: {disk:.3f}s "
-                             f"disk vs {comp:.3f}s compute "
-                             f"(overlap {ov.get('compute_disk_pct', 0)}%)"],
+                   evidence=evidence,
                    knob="SORT_MERGE_FANIN",
                    direction="raise (fewer merge passes over the runs)",
                    value=round(frac, 4), threshold=SPILL_FRACTION_GATE)

@@ -54,6 +54,10 @@ both algorithms):
                     the typed capacity rejection must absorb
 ``spill_bitrot``    flip one byte in a run's key body AFTER commit —
                     at-rest decay the merge's read-back fold catches
+``spill_block_garbage`` scramble one compressed block's header AFTER
+                    commit (SORTRUN2 runs only) — an undecodable block
+                    the reader must type as block corruption, never
+                    decode into wrong keys
 ``manifest_torn``   drop the tail of one spill-manifest journal line —
                     the crashed-mid-append shape replay skips loudly
 ``merge_stall``     block ``SORT_FAULT_STALL_MS`` at merge entry — a
@@ -127,6 +131,11 @@ SITES = (
                          # — replay must skip it loudly
     "merge_stall",       # block SORT_FAULT_STALL_MS at merge entry —
                          # the kill-resume drill's SIGKILL barrier
+    # compressed spill runs (ISSUE 20, SORTRUN2 framing):
+    "spill_block_garbage",  # scramble one compressed block's header
+                            # after commit — the reader must raise a
+                            # typed block-corruption error naming run
+                            # + block, and blame-respill must recover
 )
 
 #: Sites applied at trace time inside the compiled SPMD program (the
@@ -549,6 +558,23 @@ def spill_bitrot_word() -> int | None:
         return None
     word = reg.rand_word()
     if not reg.fire("spill_bitrot", word=word):
+        return None
+    return word
+
+
+def spill_block_garbage_word() -> int | None:
+    """Post-commit block-garbage hook (store/runs.py close path,
+    compressed SORTRUN2 runs only): a corruption word used to scramble
+    the middle block's header fields after the durable commit, or None
+    when clean.  The block becomes undecodable — framing or checksum —
+    so the reader's typed :class:`~mpitest_tpu.store.runs.
+    BlockIntegrityError` must name the run and block, and the merge's
+    blame ladder must re-spill the run, never emit garbage keys."""
+    reg = current()
+    if reg is None or not reg.would_fire("spill_block_garbage"):
+        return None
+    word = reg.rand_word()
+    if not reg.fire("spill_block_garbage", word=word):
         return None
     return word
 

@@ -1,0 +1,337 @@
+"""Spill-run block codec: order-preserving delta + bitpack compression.
+
+Spill runs are SORTED key words — the best-case delta-coding input:
+consecutive encoded keys differ by small non-negative amounts, so a
+block of 64-bit "wide" values (the codec's msw/lsw uint32 planes
+combined; lexicographic word order == numeric uint64 order) packs into
+``bit_length(max delta)`` bits per key instead of 32/64.  This module
+is the per-block codec behind the SORTRUN2 framing in store/runs.py:
+pack one block -> (packed bytes, first value, delta width, checksum);
+unpack is the exact mirror.  Deltas wrap mod 2^64, so ANY input block
+round-trips — unsorted (corrupted-upstream) data costs width, never
+correctness.
+
+Two engines, bit-identical byte for byte (the fuzz leg of
+``make sanitize-selftest`` and tests/test_store.py both hold them to
+that):
+
+* native — ``native/libspillz.so`` via ctypes (GIL released, so the
+  read-ahead/write-behind threads of store/aio.py get real
+  parallelism); built by ``make -C bench libspillz``;
+* python — the numpy fallback below, the parity oracle and the
+  always-available path.
+
+Whether runs compress AT ALL is the registered knob
+``SORT_SPILL_COMPRESS``: ``auto`` (default) compresses only when the
+native library loads (never slow the spill path down on a box without
+the .so), ``on`` forces compression (python codec if the library is
+missing), ``off`` writes raw SORTBIN1-framed runs.  The engine in use
+never changes bytes on disk — only who computes them.
+
+The block checksum is a 32-bit fold of the VALUES (not the packed
+bytes): each uint64 is avalanche-mixed (murmur3 finalizer) before an
+XOR + wrapping-sum accumulate, halves mixed down at the end.  The
+pre-mix matters — raw XOR+sum is blind to a 2^63 shift applied to an
+even-length suffix (exactly what one high packed-bit flip produces);
+the fuzzer found that, so both kernels mix first.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+import threading
+from pathlib import Path
+
+import numpy as np
+
+from mpitest_tpu.utils import knobs
+
+_REPO = Path(__file__).resolve().parents[2]
+LIB_PATH = _REPO / "native" / "libspillz.so"
+
+#: Must match SPZ_ABI_VERSION in native/spillz.h — a stale .so is
+#: refused at load, never called into.
+ABI_VERSION = 1
+
+# status codes (native/spillz.h)
+_SPZ_OK = 0
+_SPZ_EBOUNDS = -1
+_SPZ_EWIDTH = -2
+
+#: Keys per compressed block (the SORTRUN2 header stamps the value the
+#: writer used, so readers never depend on this constant matching).
+#: 4096 keeps the per-block header overhead under 0.1% while every
+#: block still decodes independently — the read-ahead granularity.
+DEFAULT_BLOCK_ELEMS = 4096
+
+
+_LOADED = False
+_LIB: ctypes.CDLL | None = None
+_LIB_ERR: str | None = None
+#: guards the one-time load: concurrent first resolutions (parallel
+#: spill writers, or a read-ahead thread racing the merge driver) must
+#: both see the COMPLETED verdict, never a half-written pair.
+_LOAD_LOCK = threading.Lock()
+
+
+def _bind(lib: ctypes.CDLL) -> None:
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    lib.spz_abi_version.restype = ctypes.c_int
+    lib.spz_abi_version.argtypes = []
+    lib.spz_pack_block.restype = ctypes.c_longlong
+    lib.spz_pack_block.argtypes = [
+        u64p, ctypes.c_size_t, u8p, ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_uint32)]
+    lib.spz_unpack_block.restype = ctypes.c_longlong
+    lib.spz_unpack_block.argtypes = [
+        u8p, ctypes.c_size_t, ctypes.c_size_t, ctypes.c_uint64,
+        ctypes.c_int, u64p, ctypes.POINTER(ctypes.c_uint32)]
+
+
+def _load() -> ctypes.CDLL | None:
+    """Load (once) and ABI-check the codec library; None + a recorded
+    reason on any failure — ``auto`` degrades to raw runs, the python
+    engine stays available for reading existing compressed runs."""
+    global _LOADED, _LIB, _LIB_ERR
+    if _LOADED:
+        return _LIB
+    with _LOAD_LOCK:
+        if _LOADED:  # another thread completed the load while we waited
+            return _LIB
+        lib: ctypes.CDLL | None = None
+        err: str | None = None
+        if not LIB_PATH.exists():
+            err = f"{LIB_PATH} not built (run `make -C bench libspillz`)"
+        else:
+            try:
+                lib = ctypes.CDLL(str(LIB_PATH))
+                _bind(lib)
+                got = int(lib.spz_abi_version())
+                if got != ABI_VERSION:
+                    err = (f"{LIB_PATH} has ABI v{got}, shim expects "
+                           f"v{ABI_VERSION} (rebuild: `make -C bench "
+                           "libspillz`)")
+                    lib = None
+            except (OSError, AttributeError) as e:
+                # AttributeError: a stale .so missing a symbol dies
+                # inside _bind() before the ABI stamp can be read —
+                # same verdict (unusable library).
+                err = (f"{LIB_PATH} failed to load: {e} "
+                       "(rebuild: `make -C bench libspillz`)")
+                lib = None
+        _LIB, _LIB_ERR = lib, err
+        _LOADED = True  # published LAST: readers never see a half-load
+    return _LIB
+
+
+def available() -> bool:
+    """True iff the native library is present, loadable and ABI-matched."""
+    return _load() is not None
+
+
+def unavailable_reason() -> str | None:
+    _load()
+    return _LIB_ERR
+
+
+def engine() -> str:
+    """The codec engine for this process: ``"native"`` when the library
+    loads, ``"python"`` otherwise.  Unlike the encode engine this is
+    NOT knob-selected — ``SORT_SPILL_COMPRESS`` decides whether runs
+    compress at all (see :func:`resolve_compress`); bytes on disk are
+    engine-independent, so which engine computes them is pure speed."""
+    return "native" if available() else "python"
+
+
+def resolve_compress(mode: str | None = None) -> bool:
+    """Resolve ``SORT_SPILL_COMPRESS`` (or an explicit ``mode``) to the
+    writer's decision: True == write SORTRUN2 compressed runs."""
+    if mode is None:
+        mode = knobs.get("SORT_SPILL_COMPRESS")
+    if mode == "off":
+        return False
+    if mode == "on":
+        return True
+    return available()  # auto: only when the fast engine is present
+
+
+def build(quiet: bool = True) -> bool:
+    """Best-effort build of the codec library (`make -C bench libspillz`)
+    — the test suite's fixture hook; selftests go through the Makefile."""
+    global _LOADED, _LIB, _LIB_ERR
+    r = subprocess.run(
+        ["make", "-C", str(_REPO / "bench"), "libspillz"],
+        capture_output=quiet, text=True)
+    with _LOAD_LOCK:  # a racing _load() must not republish a stale handle
+        _LOADED, _LIB, _LIB_ERR = False, None, None  # force a re-probe
+    return r.returncode == 0 and available()
+
+
+# --------------------------------------------------------- wide <-> words
+
+def words_to_wide(words: tuple[np.ndarray, ...]) -> np.ndarray:
+    """Codec word planes (msw first) -> one uint64 "wide" array whose
+    numeric order equals the planes' lexicographic order."""
+    if len(words) == 1:
+        return words[0].astype(np.uint64)
+    return ((words[0].astype(np.uint64) << np.uint64(32))
+            | words[1].astype(np.uint64))
+
+
+def wide_to_words(wide: np.ndarray, n_words: int) -> tuple[np.ndarray, ...]:
+    """Inverse of :func:`words_to_wide` (msw first)."""
+    if n_words == 1:
+        return (wide.astype(np.uint32),)
+    return ((wide >> np.uint64(32)).astype(np.uint32),
+            wide.astype(np.uint32))
+
+
+# ------------------------------------------------------------ value fold
+
+def _mix64(z: np.ndarray) -> np.ndarray:
+    """Vectorized murmur3 finalizer (wrapping uint64 arithmetic)."""
+    z = z.astype(np.uint64, copy=True)
+    z ^= z >> np.uint64(33)
+    z *= np.uint64(0xFF51AFD7ED558CCD)
+    z ^= z >> np.uint64(33)
+    z *= np.uint64(0xC4CEB9FE1A85EC53)
+    z ^= z >> np.uint64(33)
+    return z
+
+
+def _fold(vals: np.ndarray) -> int:
+    """The spz_fold rule of native/spillz.c, elementwise-vectorized:
+    m = mix64(vals); x = XOR(m); s = sum(m) mod 2^64; halves mixed."""
+    if vals.size == 0:
+        return 0
+    m = _mix64(vals)
+    x = int(np.bitwise_xor.reduce(m))
+    s = int(np.sum(m, dtype=np.uint64))
+    v = x ^ (x >> 32) ^ s ^ (s >> 32)
+    return v & 0xFFFFFFFF
+
+
+def checksum_bytes(data: bytes) -> int:
+    """32-bit fold of a raw byte block (payload blocks): zero-pad to a
+    multiple of 8, view little-endian uint64, same value fold as keys."""
+    if not data:
+        return 0
+    pad = (-len(data)) % 8
+    if pad:
+        data = data + b"\x00" * pad
+    return _fold(np.frombuffer(data, dtype="<u8"))
+
+
+# ------------------------------------------------------------ block codec
+
+def pack_block(vals: np.ndarray,
+               eng: str | None = None) -> tuple[bytes, int, int, int]:
+    """Pack one block of wide (uint64) values.  Returns
+    ``(packed, first, width, checksum)`` where ``packed`` holds the
+    (n-1) wrapping deltas at ``width`` bits each, LSB-first, zero-padded
+    to whole bytes — exactly ``ceil((n-1)*width/8)`` bytes.  Both
+    engines return identical bytes on every input."""
+    vals = np.ascontiguousarray(vals, dtype=np.uint64)
+    n = int(vals.size)
+    if n == 0:
+        raise ValueError("pack_block: empty block (the run framing "
+                         "never writes one)")
+    if eng is None:
+        eng = engine()
+    if eng != "native":
+        return _pack_python(vals)
+    lib = _load()
+    assert lib is not None, "engine() guards this path"
+    cap = n * 8 + 8
+    out = np.empty(cap, np.uint8)
+    first = ctypes.c_uint64()
+    width = ctypes.c_int()
+    chk = ctypes.c_uint32()
+    rc = int(lib.spz_pack_block(
+        vals.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), n,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), cap,
+        ctypes.byref(first), ctypes.byref(width), ctypes.byref(chk)))
+    if rc < 0:  # unreachable with the cap above; refuse to write garbage
+        raise ValueError(f"spz_pack_block failed: status {rc}")
+    return (out[:rc].tobytes(), int(first.value), int(width.value),
+            int(chk.value))
+
+
+def _pack_python(vals: np.ndarray) -> tuple[bytes, int, int, int]:
+    n = int(vals.size)
+    first = int(vals[0])
+    chk = _fold(vals)
+    if n == 1:
+        return b"", first, 0, chk
+    deltas = vals[1:] - vals[:-1]  # uint64 wrapping, like the C kernel
+    width = int(deltas.max()).bit_length()
+    if width == 0:
+        return b"", first, 0, chk
+    shifts = np.arange(width, dtype=np.uint64)
+    bits = ((deltas[:, None] >> shifts) & np.uint64(1)).astype(np.uint8)
+    packed = np.packbits(bits.reshape(-1), bitorder="little")
+    return packed.tobytes(), first, width, chk
+
+
+def unpack_block(data: bytes, n: int, first: int, width: int,
+                 eng: str | None = None) -> tuple[np.ndarray, int]:
+    """Unpack one block: ``(values, checksum)`` reconstructed from the
+    packed bytes and the block header's (n, first, width).  Raises
+    ValueError on ANY framing inconsistency (width outside 0..64,
+    ``len(data) != ceil((n-1)*width/8)``) from either engine — the
+    caller types it as block corruption.  The returned checksum is
+    folded from the RECONSTRUCTED values; the caller compares it
+    against the stored one."""
+    if n <= 0:
+        raise ValueError(f"unpack_block: bad element count {n}")
+    if eng is None:
+        eng = engine()
+    if eng != "native":
+        return _unpack_python(data, n, first, width)
+    lib = _load()
+    assert lib is not None, "engine() guards this path"
+    buf = np.frombuffer(data, np.uint8) if data else np.zeros(1, np.uint8)
+    vals = np.empty(n, np.uint64)
+    chk = ctypes.c_uint32()
+    rc = int(lib.spz_unpack_block(
+        buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(data), n,
+        ctypes.c_uint64(first & 0xFFFFFFFFFFFFFFFF), width,
+        vals.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        ctypes.byref(chk)))
+    if rc == _SPZ_EWIDTH:
+        raise ValueError(f"unpack_block: delta width {width} outside 0..64")
+    if rc < 0:
+        raise ValueError(
+            f"unpack_block: {len(data)} packed bytes disagree with "
+            f"(n={n}, width={width})")
+    return vals, int(chk.value)
+
+
+def _unpack_python(data: bytes, n: int, first: int,
+                   width: int) -> tuple[np.ndarray, int]:
+    if width < 0 or width > 64:
+        raise ValueError(f"unpack_block: delta width {width} outside 0..64")
+    need = ((n - 1) * width + 7) // 8
+    if len(data) != need:
+        raise ValueError(
+            f"unpack_block: {len(data)} packed bytes disagree with "
+            f"(n={n}, width={width})")
+    f64 = np.uint64(first & 0xFFFFFFFFFFFFFFFF)
+    vals = np.empty(n, np.uint64)
+    vals[0] = f64
+    if n > 1:
+        if width == 0:
+            vals[1:] = f64
+        else:
+            nbits = (n - 1) * width
+            raw = np.frombuffer(data, np.uint8)
+            bits = np.unpackbits(raw, count=nbits,
+                                 bitorder="little").reshape(n - 1, width)
+            deltas = np.zeros(n - 1, np.uint64)
+            for j in range(width):
+                deltas |= bits[:, j].astype(np.uint64) << np.uint64(j)
+            vals[1:] = f64 + np.cumsum(deltas, dtype=np.uint64)
+    return vals, _fold(vals)

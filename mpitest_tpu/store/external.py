@@ -67,6 +67,7 @@ import numpy as np
 from mpitest_tpu.models import plan as plan_mod
 from mpitest_tpu.models.supervisor import SortIntegrityError
 from mpitest_tpu.ops.keys import codec_for
+from mpitest_tpu.store import aio
 from mpitest_tpu.store import manifest as mfstlib
 from mpitest_tpu.store import merge as mergelib
 from mpitest_tpu.store import runs as runlib
@@ -87,7 +88,7 @@ MERGE_ATTEMPTS = 2
 #: Spill-artifact suffixes the orphan GC may reclaim (age-gated,
 #: manifest-referenced files excluded) — run files, staging files,
 #: durable-commit temps, and journals themselves.
-GC_SUFFIXES = (".run", ".pay", ".fpr.json", ".spill", ".tmp",
+GC_SUFFIXES = (".run", ".runz", ".pay", ".fpr.json", ".spill", ".tmp",
                mfstlib.MANIFEST_SUFFIX)
 
 
@@ -119,6 +120,13 @@ class ExternalResult:
     #: runs re-validated from a journaled manifest instead of being
     #: re-sorted (ISSUE 18 crash resume; 0 = cold run)
     resumed_runs: int = 0
+    #: logical bytes / spilled bytes of the partition runs (ISSUE 20):
+    #: > 1.0 when SORTRUN2 compression shrank the spill, 0.0 when
+    #: nothing spilled
+    spill_ratio: float = 0.0
+    #: fraction of the final merge's disk time that overlapped its
+    #: compute (read-ahead/write-behind concurrency; 0.0 = synchronous)
+    disk_overlap: float = 0.0
 
 
 def _budget() -> int:
@@ -207,22 +215,33 @@ def _merge_level(level: "list[runlib.RunInfo]", spill_dir: str,
         w = runlib.RunStreamWriter(
             spill_dir, f"m{os.getpid():x}_{pass_idx}_{gi:05d}",
             dtype, width)
+        # async IO engine (ISSUE 20): per-run read-ahead decode +
+        # write-behind encode, so the pass's disk time overlaps its
+        # merge compute instead of alternating with it
+        io = aio.MergeIO()
+        wb = io.wrap_writer(w)
         try:
-            for kws, pws in mergelib.merge_runs(group, ch):
-                w.append_words(kws, pws)
-            info = w.close()
+            for kws, pws in mergelib.merge_runs(group, ch, io=io):
+                wb.append_words(kws, pws)
+            info = wb.close()
         except BaseException:
             # an ENOSPC (or integrity failure) mid-pass must not leak
             # the half-written intermediate run
-            w.abort()
+            wb.abort()
             raise
+        finally:
+            io.close()
+        iostats = io.stats(t0, time.perf_counter())
         spans = _spans(tracer)
         if spans is not None:
             spans.record("external.merge", t0,
                          time.perf_counter() - t0,
                          runs=len(group), n=info.n,
                          bytes=info.disk_bytes, final=False,
-                         merge_pass=pass_idx)
+                         merge_pass=pass_idx,
+                         disk_overlap=iostats["disk_overlap"],
+                         disk_busy_s=iostats["disk_busy_s"],
+                         overlap_s=iostats["overlap_s"])
         out.append(info)
     return out
 
@@ -615,6 +634,8 @@ def _merge_with_recovery(
     out.recoveries = recoveries
     out.merge_passes = merge_passes
     out.resumed_runs = resumed_count
+    rec_bytes = int(np.dtype(dtype).itemsize) + int(width)
+    out.spill_ratio = (n * rec_bytes / disk0) if disk0 else 0.0
     tracer.counters["external_runs"] = out.runs
     tracer.counters["external_disk_bytes"] = out.disk_bytes
     tracer.counters["external_merge_passes"] = out.merge_passes
@@ -664,9 +685,13 @@ def _merge_all(
     t0 = time.perf_counter()
     ch = merge_chunk_elems(budget, dtype, width, len(level))
 
+    # async IO engine (ISSUE 20): read-ahead sources for every input
+    # run + (file sink) a write-behind on the output writer; the final
+    # span carries the measured disk/compute overlap
+    io = aio.MergeIO()
     out_keys: list[np.ndarray] = []
     out_pay: list[np.ndarray] = []
-    writer: runlib.RunStreamWriter | None = None
+    wb: "aio.WriteBehind | None" = None
     emit: Callable[[np.ndarray, np.ndarray | None], None]
     if sink == "array":
         def emit(k: np.ndarray, p: np.ndarray | None) -> None:
@@ -674,11 +699,15 @@ def _merge_all(
             if p is not None:
                 out_pay.append(p)
     elif sink == "file":
-        writer = runlib.RunStreamWriter(spill_dir, out_name, dtype,
-                                        width)
+        # the OUTPUT run is always raw (compress=False): consumers
+        # (the serve spill tier's zero-copy wire path, run_body_views)
+        # read its body directly — only intermediate spill traffic
+        # rides the compressed SORTRUN2 framing
+        wb = io.wrap_writer(runlib.RunStreamWriter(
+            spill_dir, out_name, dtype, width, compress=False))
 
         def emit(k: np.ndarray, p: np.ndarray | None) -> None:
-            writer.append(k, p)
+            wb.append(k, p)
     elif callable(sink):
         emit = sink
     else:
@@ -690,8 +719,9 @@ def _merge_all(
     got_n = 0
     prev_last: tuple[int, ...] | None = None
     sorted_ok = True
+    out_info: "runlib.RunInfo | None" = None
     try:
-        for kws, pws in mergelib.merge_runs(level, ch):
+        for kws, pws in mergelib.merge_runs(level, ch, io=io):
             cfp = runlib.run_fingerprint(kws, pws)
             got_fp = cfp if got_fp is None else got_fp.combine(cfp)
             m = int(kws[0].size)
@@ -706,16 +736,23 @@ def _merge_all(
             keys_dec = codec.decode(kws)
             pay_dec = words_to_payload(pws, m, width) if width else None
             emit(keys_dec, pay_dec)
+        if wb is not None:
+            # drain + publish BEFORE verification so the not-ok path
+            # below can delete the published names
+            out_info = wb.close()
     except BaseException:
-        if writer is not None:
-            # close AND delete the partial output run: a failed merge
-            # must not leak a dataset-sized out_<name> file per attempt
-            # (the serve spill tier mints a fresh name per request)
-            runlib.remove_run(writer.close())
+        if wb is not None:
+            # stop the worker and delete the partial output run: a
+            # failed merge must not leak a dataset-sized out_<name>
+            # file per attempt (the serve spill tier mints a fresh
+            # name per request)
+            wb.abort()
         raise
     finally:
+        io.close()
         for r in created:
             runlib.remove_run(r)
+    iostats = io.stats(t0, time.perf_counter())
 
     ok = (sorted_ok and got_n == n
           and (got_fp == expected_fp if got_fp is not None else n == 0))
@@ -725,18 +762,22 @@ def _merge_all(
                     fp_ok=bool(got_fp == expected_fp or n == 0), n=n)
         spans.record("external.merge", t0, time.perf_counter() - t0,
                      runs=len(level), n=got_n, final=True,
-                     merge_pass=merge_passes)
+                     merge_pass=merge_passes,
+                     disk_overlap=iostats["disk_overlap"],
+                     disk_busy_s=iostats["disk_busy_s"],
+                     overlap_s=iostats["overlap_s"])
     if not ok:
         tracer.count("verify_failures", 1)
-        if writer is not None:
-            runlib.remove_run(writer.close())  # see the except above
+        if out_info is not None:
+            runlib.remove_run(out_info)  # see the except above
         raise SortIntegrityError(
             f"merged output failed verification (sorted={sorted_ok}, "
             f"n={got_n}/{n}, fingerprint="
             f"{'ok' if got_fp == expected_fp else 'MISMATCH'})")
 
     res = ExternalResult(n, dtype, width, len(run_infos), 0,
-                         merge_passes, 0)
+                         merge_passes, 0,
+                         disk_overlap=iostats["disk_overlap"])
     if sink == "array":
         res.keys = (np.concatenate(out_keys) if out_keys
                     else np.empty(0, dtype))
@@ -744,7 +785,7 @@ def _merge_all(
             res.payload = (np.concatenate(out_pay) if out_pay
                            else np.zeros((0, width), np.uint8))
     elif sink == "file":
-        res.out_run = writer.close()
+        res.out_run = out_info
     return res, merge_passes
 
 
@@ -818,7 +859,9 @@ def _finish_plan(tracer: Any, res: ExternalResult, budget: int,
     plan.actual("external", runs=res.runs, disk_bytes=res.disk_bytes,
                 merge_passes=res.merge_passes,
                 recoveries=res.recoveries,
-                resumed=res.resumed_runs)
+                resumed=res.resumed_runs,
+                spill_ratio=round(res.spill_ratio, 3),
+                disk_overlap=round(res.disk_overlap, 3))
     if res.recoveries:
         plan.bump("external", "recoveries", float(res.recoveries))
     plan.finalize()

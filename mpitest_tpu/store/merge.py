@@ -87,6 +87,12 @@ class _Cursor:
             return False
         try:
             keys, pay = next(self.chunks)
+        except runlib.BlockIntegrityError as e:
+            # a compressed block failed its framing/checksum mid-read
+            # (ISSUE 20): surface it as the SAME typed blame the fold
+            # mismatch raises, so the external driver's re-spill
+            # recovery covers compressed corruption too
+            raise RunIntegrityError(self.info, str(e)) from None
         except StopIteration:
             self.file_done = True
             fp = self.fold
@@ -200,22 +206,43 @@ def _first_key(cur: _Cursor) -> tuple[int, ...]:
 
 
 def merge_runs(infos: list["runlib.RunInfo"], chunk_elems: int,
-               ) -> Iterator[tuple[tuple, tuple]]:
+               io=None) -> Iterator[tuple[tuple, tuple]]:
     """Merge sorted runs, yielding ``(key_words, payload_words)``
     chunks in globally sorted (stable: key, then run, then in-run
     position) order.  Host memory is bounded by roughly
     ``len(infos) * chunk_elems`` records of buffer plus one output
     round.  Callers wanting a multi-pass (fan-in-limited) merge drive
     this through :func:`store.external` — this function merges every
-    run it is handed in one pass."""
+    run it is handed in one pass.
+
+    ``io`` (ISSUE 20) is an optional :class:`store.aio.MergeIO`: when
+    given, each cursor's chunk stream comes from ``io.source(info,
+    chunk_elems)`` — a read-ahead thread that decodes the NEXT disk
+    block while this loop consumes the current one — instead of the
+    synchronous :func:`store.runs.read_run_chunks`.  The chunk
+    contents are identical either way; only the overlap changes."""
     if not infos:
         return
     chunk_elems = max(1, int(chunk_elems))
     cursors = [
         _Cursor(info=ri, run_id=i,
-                chunks=runlib.read_run_chunks(ri, chunk_elems))
+                chunks=(io.source(ri, chunk_elems) if io is not None
+                        else runlib.read_run_chunks(ri, chunk_elems)))
         for i, ri in enumerate(infos)
     ]
+    try:
+        yield from _merge_cursors(cursors)
+    finally:
+        # close every chunk source (sync generators AND read-ahead
+        # threads) even when the consumer abandons the merge mid-way
+        for c in cursors:
+            close = getattr(c.chunks, "close", None)
+            if close is not None:
+                close()
+
+
+def _merge_cursors(cursors: list[_Cursor],
+                   ) -> Iterator[tuple[tuple, tuple]]:
     for c in cursors:
         c.refill()
     out_idx = 0

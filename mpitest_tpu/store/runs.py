@@ -19,6 +19,20 @@ external sort (``store/external.py``) can exceed device/host memory:
   compares at run exhaustion, so bad disk bytes (or the injected
   ``spill_corrupt`` fault) are caught before they can ship.
 
+Compressed runs (ISSUE 20) swap the framing, not the contract: a
+``<name>.runz`` key file is ``SORTRUN2`` — the sorted keys' encoded
+words delta-coded and bitpacked in fixed-size independently-decodable
+blocks (``store/compress.py``), each with its own 24-byte header
+(count, delta width, first value, packed length, checksum); the
+payload section becomes ``SORTPAY2`` (same raw bytes, 8-byte per-block
+headers).  The fingerprint sidecar STILL folds the decompressed words,
+so integrity blame names the run identically, and a block whose
+framing or checksum disagrees raises the typed
+:class:`BlockIntegrityError` naming run + block — never silently-wrong
+keys.  Whether new runs compress is the ``SORT_SPILL_COMPRESS`` knob;
+readers dispatch on the file magic, so raw and compressed runs mix
+freely in one merge.
+
 This module is the ONE place run files are opened — sortlint rule
 SL014 fences ad-hoc ``open()`` of spill paths everywhere else, so the
 framing/sidecar contract cannot be quietly bypassed.
@@ -34,6 +48,8 @@ from __future__ import annotations
 
 import json
 import os
+import threading
+import time
 from dataclasses import dataclass
 
 import numpy as np
@@ -42,11 +58,27 @@ from mpitest_tpu import faults
 from mpitest_tpu.models.verify import (Fingerprint, fingerprint_host,
                                        fingerprint_records)
 from mpitest_tpu.ops.keys import codec_for
+from mpitest_tpu.store import compress as blockz
 from mpitest_tpu.utils import io as kio
+from mpitest_tpu.utils import knobs
 
 #: Payload-section magic (the key section reuses ``kio.BIN_MAGIC``).
 PAY_MAGIC = b"SORTPAY1"
 PAY_HEADER_LEN = 16
+
+#: Compressed-run framing (ISSUE 20).  SORTRUN2 key header (16 bytes,
+#: same length as SORTBIN1 so the version/kind offsets line up):
+#: magic[8] | kind[1] | itemsize[1] | format_version[1] | n_words[1] |
+#: block_elems u32 LE[4].  Each block: n u32 | width u8 | reserved[3] |
+#: first u64 | packed_len u32 | checksum u32, then the packed bytes.
+RUNZ_MAGIC = b"SORTRUN2"
+RUNZ_HEADER_LEN = 16
+RUNZ_BLOCK_HEADER_LEN = 24
+
+#: Compressed payload section: magic[8] | width u32 LE | version[1] |
+#: zeros[3]; blocks 1:1 with key blocks, each ``n u32 | checksum u32``
+#: then ``n * width`` raw payload bytes.
+PAY2_MAGIC = b"SORTPAY2"
 
 #: Sidecar schema tag.
 FP_SCHEMA = "sortfp1"
@@ -57,8 +89,12 @@ FP_SCHEMA = "sortfp1"
 #: stay readable by every existing SORTBIN1 consumer), plus the sidecar
 #: and the spill manifest.  Version 0 is the pre-versioning framing
 #: (reserved bytes all zero) — still readable.
-RUN_FORMAT_VERSION = 1
-COMPAT_FORMAT_VERSIONS = (0, 1)
+#: Version 2 (ISSUE 20) introduces the compressed SORTRUN2/SORTPAY2
+#: framing; RAW runs also stamp 2 (the version names the writer
+#: generation, the magic names the framing) and versions 0/1 stay
+#: readable.
+RUN_FORMAT_VERSION = 2
+COMPAT_FORMAT_VERSIONS = (0, 1, 2)
 
 #: Byte offsets of the version stamp inside the two 16-byte headers.
 BIN_VERSION_OFF = 10
@@ -78,6 +114,52 @@ class RunVersionError(RunFormatError):
     alone.  A distinct type so crash-resume can re-sort around disk
     *damage* while still surfacing version skew typed: damage is
     recoverable from source, silent cross-version misreads are not."""
+
+
+class BlockIntegrityError(RunFormatError):
+    """One compressed block of a SORTRUN2/SORTPAY2 run is undecodable
+    or fails its checksum — garbage framing fields, a torn body, or
+    bytes that no longer fold to the stored block checksum.  Always
+    names the run path AND the block index, so the merge's blame ladder
+    (:class:`store.merge.RunIntegrityError`) can re-spill exactly the
+    damaged run."""
+
+    def __init__(self, path: str, block: int, detail: str) -> None:
+        self.path = str(path)
+        self.block = int(block)
+        super().__init__(
+            f"run file {path!r}: compressed block {block}: {detail}")
+
+
+# --------------------------------------------------------- disk throttle
+#
+# SORT_SPILL_THROTTLE_MBPS simulates ONE disk of bounded bandwidth for
+# the whole process: a module-level token bucket every spill read/write
+# charges actual bytes moved against.  Shared state is the point — the
+# read-ahead threads of store/aio.py each stream a different run, and
+# per-thread throttles would multiply the simulated bandwidth by the
+# merge fanin.  The sleep happens OUTSIDE the lock (threadlint TL003):
+# the lock only computes this transfer's reservation window.
+
+_THROTTLE_LOCK = threading.Lock()
+_throttle_next = 0.0
+
+
+def throttle_disk(nbytes: int) -> None:
+    """Charge ``nbytes`` against the simulated spill-disk bandwidth
+    (no-op when ``SORT_SPILL_THROTTLE_MBPS`` is 0, the default)."""
+    global _throttle_next
+    mbps = float(knobs.get("SORT_SPILL_THROTTLE_MBPS"))
+    if mbps <= 0.0 or nbytes <= 0:
+        return
+    cost = nbytes / (mbps * 1e6)
+    with _THROTTLE_LOCK:
+        now = time.monotonic()
+        start = _throttle_next if _throttle_next > now else now
+        _throttle_next = start + cost
+        wait = _throttle_next - now
+    if wait > 0:
+        time.sleep(wait)
 
 
 def fsync_dir(path: str) -> None:
@@ -120,16 +202,48 @@ def _pay_header(width: int) -> bytes:
     return bytes(h)
 
 
+def _runz_header(dtype: np.dtype, n_words: int, block_elems: int) -> bytes:
+    h = bytearray(RUNZ_MAGIC)
+    h.append(ord(dtype.kind))
+    h.append(dtype.itemsize)
+    h.append(RUN_FORMAT_VERSION)
+    h.append(n_words)
+    h += int(block_elems).to_bytes(4, "little")
+    return bytes(h)
+
+
+def _pay2_header(width: int) -> bytes:
+    h = bytearray(PAY2_MAGIC + int(width).to_bytes(4, "little")
+                  + b"\0" * 4)
+    h[PAY_VERSION_OFF] = RUN_FORMAT_VERSION
+    return bytes(h)
+
+
+def _runz_block_header(n: int, width: int, first: int, packed_len: int,
+                       checksum: int) -> bytes:
+    return (int(n).to_bytes(4, "little") + bytes([width]) + b"\0" * 3
+            + int(first).to_bytes(8, "little")
+            + int(packed_len).to_bytes(4, "little")
+            + int(checksum).to_bytes(4, "little"))
+
+
+def _runz_pay_blocks(n: int, block_elems: int) -> int:
+    """Number of payload/key blocks a compressed run of ``n`` records
+    holds (the writer flushes full blocks plus one remainder)."""
+    return (n + block_elems - 1) // block_elems if n else 0
+
+
 @dataclass(frozen=True)
 class RunInfo:
     """One opened (or freshly written) spill run."""
 
-    path: str                 # the .run key file
+    path: str                 # the .run (raw) / .runz (compressed) key file
     n: int                    # records in the run
     dtype: np.dtype
     payload_width: int        # bytes per record payload (0 = keys only)
     fingerprint: Fingerprint  # sidecar fold (sorted words, pre-disk)
     disk_bytes: int           # total bytes on disk (keys + payload)
+    compressed: bool = False  # SORTRUN2 block-compressed framing
 
     @property
     def pay_path(self) -> str:
@@ -148,6 +262,26 @@ def run_fingerprint(key_words: tuple[np.ndarray, ...],
     if payload_words:
         return fingerprint_records(key_words, payload_words)
     return fingerprint_host(key_words)
+
+
+def _take_pending(bufs: list[np.ndarray], take: int) -> np.ndarray:
+    """Pop exactly ``take`` leading rows from a list of buffered arrays
+    (1-D keys or (m, width) payload), splitting the boundary array in
+    place — the compressed writer's block former."""
+    out: list[np.ndarray] = []
+    got = 0
+    while got < take:
+        a = bufs[0]
+        need = take - got
+        if len(a) <= need:
+            out.append(a)
+            got += len(a)
+            bufs.pop(0)
+        else:
+            out.append(a[:need])
+            bufs[0] = a[need:]
+            got = take
+    return out[0] if len(out) == 1 else np.concatenate(out)
 
 
 class RunStreamWriter:
@@ -169,26 +303,45 @@ class RunStreamWriter:
     name."""
 
     def __init__(self, spill_dir: str, name: str, dtype: np.dtype,
-                 payload_width: int = 0, durable: bool = False) -> None:
+                 payload_width: int = 0, durable: bool = False,
+                 compress: bool | None = None,
+                 block_elems: int = blockz.DEFAULT_BLOCK_ELEMS) -> None:
         os.makedirs(spill_dir, exist_ok=True)
-        self.path = os.path.join(spill_dir, f"{name}.run")
+        if compress is None:
+            compress = blockz.resolve_compress()
+        self.compressed = bool(compress)
+        ext = ".runz" if self.compressed else ".run"
+        self.path = os.path.join(spill_dir, f"{name}{ext}")
         self.durable = bool(durable)
         self._dir = spill_dir
         self._suffix = ".tmp" if self.durable else ""
         self.dtype = np.dtype(dtype)
         self.codec = codec_for(self.dtype)
         self.payload_width = int(payload_width)
+        self.block_elems = max(1, int(block_elems))
         self.n = 0
         self.disk_bytes = 0
         self._fp: Fingerprint | None = None
         self._chunks = 0
+        self._key_body = 0  # key bytes written after the 16-byte header
+        self._blocks: list[tuple[int, int]] = []  # (offset, len) per block
+        self._pend_keys: list[np.ndarray] = []
+        self._pend_pay: list[np.ndarray] = []
+        self._pend_n = 0
         self._kf = open(self.path + self._suffix, "wb")
-        self._kf.write(_run_bin_header(self.dtype))
-        self.disk_bytes += kio.BIN_HEADER_LEN
+        if self.compressed:
+            self._kf.write(_runz_header(self.dtype, self.codec.n_words,
+                                        self.block_elems))
+            self.disk_bytes += RUNZ_HEADER_LEN
+        else:
+            self._kf.write(_run_bin_header(self.dtype))
+            self.disk_bytes += kio.BIN_HEADER_LEN
         self._pf = None
         if self.payload_width:
             self._pf = open(self.path + ".pay" + self._suffix, "wb")
-            self._pf.write(_pay_header(self.payload_width))
+            self._pf.write(_pay2_header(self.payload_width)
+                           if self.compressed
+                           else _pay_header(self.payload_width))
             self.disk_bytes += PAY_HEADER_LEN
 
     def append(self, keys_sorted: np.ndarray,
@@ -219,12 +372,57 @@ class RunStreamWriter:
             key_bytes = faults.maybe_corrupt_spill(key_bytes)
         self._chunks += 1
         faults.maybe_spill_enospc(len(key_bytes))
-        self._kf.write(key_bytes)
-        self.disk_bytes += len(key_bytes)
-        if pay is not None:
-            self._pf.write(pay.tobytes())
-            self.disk_bytes += pay.nbytes
+        if self.compressed:
+            # reconstruct from the (possibly drill-corrupted) disk
+            # bytes: the block codec must compress exactly what a raw
+            # run would have persisted, so every block's checksum is
+            # self-consistent and ONLY the sidecar fold can catch the
+            # spill_corrupt shape — same detection story as raw runs
+            self._pend_keys.append(np.frombuffer(key_bytes, self.dtype))
+            if pay is not None:
+                self._pend_pay.append(pay)
+            self._pend_n += m
+            self._flush_blocks(final=False)
+        else:
+            throttle_disk(len(key_bytes))
+            self._kf.write(key_bytes)
+            self.disk_bytes += len(key_bytes)
+            self._key_body += len(key_bytes)
+            if pay is not None:
+                throttle_disk(pay.nbytes)
+                self._pf.write(pay.tobytes())
+                self.disk_bytes += pay.nbytes
         self.n += m
+
+    def _flush_blocks(self, final: bool) -> None:
+        """Compress+write full buffered blocks (every block except the
+        run's last holds exactly ``block_elems`` records; ``final``
+        drains the remainder at close)."""
+        while self._pend_n >= self.block_elems or (final and
+                                                   self._pend_n > 0):
+            take = min(self.block_elems, self._pend_n)
+            keys = _take_pending(self._pend_keys, take)
+            wide = blockz.words_to_wide(self.codec.encode(keys))
+            packed, first, width, chk = blockz.pack_block(wide)
+            bh = _runz_block_header(take, width, first, len(packed), chk)
+            off = RUNZ_HEADER_LEN + self._key_body
+            throttle_disk(len(bh) + len(packed))
+            self._kf.write(bh)
+            self._kf.write(packed)
+            blen = RUNZ_BLOCK_HEADER_LEN + len(packed)
+            self._blocks.append((off, blen))
+            self._key_body += blen
+            self.disk_bytes += blen
+            if self._pf is not None:
+                pay_bytes = _take_pending(self._pend_pay, take).tobytes()
+                pbh = (int(take).to_bytes(4, "little")
+                       + int(blockz.checksum_bytes(pay_bytes)).to_bytes(
+                           4, "little"))
+                throttle_disk(len(pbh) + len(pay_bytes))
+                self._pf.write(pbh)
+                self._pf.write(pay_bytes)
+                self.disk_bytes += len(pbh) + len(pay_bytes)
+            self._pend_n -= take
 
     def append_words(self, key_words: tuple[np.ndarray, ...],
                      payload_words: tuple[np.ndarray, ...]) -> None:
@@ -260,6 +458,8 @@ class RunStreamWriter:
                     pass
 
     def close(self) -> RunInfo:
+        if self.compressed:
+            self._flush_blocks(final=True)
         if self.durable:
             for f in (self._kf, self._pf):
                 if f is not None:
@@ -292,13 +492,12 @@ class RunStreamWriter:
                 os.replace(self.path + ".pay.tmp", self.path + ".pay")
             os.replace(sc_path + ".tmp", sc_path)
             fsync_dir(self._dir)
-        # disk-fault drills (ISSUE 18), applied to the PUBLISHED file:
-        # a torn tail (bytes that never really hit the platter) and
-        # post-commit bit rot — both leave the sidecar/manifest
+        # disk-fault drills (ISSUE 18 + ISSUE 20), applied to the
+        # PUBLISHED file: a torn tail (bytes that never really hit the
+        # platter), post-commit bit rot, and — compressed runs only —
+        # a scrambled block header; all leave the sidecar/manifest
         # promising bytes the disk no longer honestly holds
-        body = self.disk_bytes - kio.BIN_HEADER_LEN \
-            - (PAY_HEADER_LEN if self.payload_width else 0) \
-            - (self.n * self.payload_width)
+        body = self._key_body
         cut = faults.spill_tear_bytes(body)
         if cut:
             os.truncate(self.path,
@@ -312,13 +511,27 @@ class RunStreamWriter:
                 if b:
                     f.seek(off)
                     f.write(bytes([b[0] ^ ((rot & 0xFF) or 0x5A)]))
+        gw = faults.spill_block_garbage_word()
+        if gw is not None and self.compressed and self._blocks:
+            # scramble the MIDDLE block's header payload (first value,
+            # packed length, checksum — bytes 8..24): the reader must
+            # fail the framing or checksum check for that exact block
+            off, blen = self._blocks[len(self._blocks) // 2]
+            span = min(16, blen - 8)
+            with open(self.path, "r+b") as f:
+                f.seek(off + 8)
+                cur = f.read(span)
+                f.seek(off + 8)
+                f.write(bytes(b ^ 0xA5 for b in cur))
         return RunInfo(self.path, self.n, self.dtype,
-                       self.payload_width, fp, self.disk_bytes)
+                       self.payload_width, fp, self.disk_bytes,
+                       compressed=self.compressed)
 
 
 def write_run(spill_dir: str, name: str, keys_sorted: np.ndarray,
               payload_sorted: np.ndarray | None = None,
-              durable: bool = False) -> RunInfo:
+              durable: bool = False,
+              compress: bool | None = None) -> RunInfo:
     """Persist one sorted run: keys as SORTBIN1, payload (optional) as
     SORTPAY1, fingerprint sidecar folded from the HOST words before any
     byte reaches disk.  ``payload_sorted`` is a ``(n, width)`` uint8
@@ -337,7 +550,7 @@ def write_run(spill_dir: str, name: str, keys_sorted: np.ndarray,
                 f"{int(keys_sorted.size)} records")
         width = int(pay.shape[1])
     w = RunStreamWriter(spill_dir, name, keys_sorted.dtype, width,
-                        durable=durable)
+                        durable=durable, compress=compress)
     try:
         w.append(keys_sorted, payload_sorted if width else None)
         return w.close()
@@ -384,19 +597,44 @@ def open_run(path: str) -> RunInfo:
         st = os.stat(path)
     except OSError as e:
         raise RunFormatError(f"run file {path!r} unreadable: {e}") from None
-    body = st.st_size - kio.BIN_HEADER_LEN
     n = int(sc["n"])
-    if body != n * dtype.itemsize:
-        raise RunFormatError(
-            f"run file {path!r}: {body} key bytes on disk but the "
-            f"sidecar says {n} x {dtype.itemsize}-byte records "
-            "(truncated or torn write)")
     with open(path, "rb") as f:
         head = f.read(kio.BIN_HEADER_LEN)
-    if head[:8] != kio.BIN_MAGIC:
-        raise RunFormatError(f"run file {path!r} is not SORTBIN1-framed")
-    kio._check_bin_header(head, path, dtype)
-    _check_format_version(head[BIN_VERSION_OFF], path)
+    compressed = head[:8] == RUNZ_MAGIC
+    if compressed:
+        if len(head) < RUNZ_HEADER_LEN:
+            raise RunFormatError(
+                f"run file {path!r}: truncated SORTRUN2 header")
+        if (chr(head[8]), head[9]) != (dtype.kind, dtype.itemsize):
+            raise RunFormatError(
+                f"run file {path!r} holds {chr(head[8])}{head[9] * 8} "
+                f"keys, not {dtype.name}")
+        _check_format_version(head[BIN_VERSION_OFF], path)
+        codec = codec_for(dtype)
+        if head[11] != codec.n_words:
+            raise RunFormatError(
+                f"run file {path!r}: {head[11]} key words in the "
+                f"header, codec says {codec.n_words}")
+        block_elems = int.from_bytes(head[12:16], "little")
+        if block_elems < 1:
+            raise RunFormatError(
+                f"run file {path!r}: bad block_elems {block_elems}")
+        # no fixed key-body size for compressed runs — each block
+        # declares its own length; framing damage surfaces as a typed
+        # BlockIntegrityError at read time instead
+    else:
+        body = st.st_size - kio.BIN_HEADER_LEN
+        if body != n * dtype.itemsize:
+            raise RunFormatError(
+                f"run file {path!r}: {body} key bytes on disk but the "
+                f"sidecar says {n} x {dtype.itemsize}-byte records "
+                "(truncated or torn write)")
+        if head[:8] != kio.BIN_MAGIC:
+            raise RunFormatError(
+                f"run file {path!r} is not SORTBIN1-framed")
+        kio._check_bin_header(head, path, dtype)
+        _check_format_version(head[BIN_VERSION_OFF], path)
+        block_elems = 0
     width = int(sc.get("payload_width", 0))
     disk = st.st_size
     if width:
@@ -406,27 +644,39 @@ def open_run(path: str) -> RunInfo:
         except OSError as e:
             raise RunFormatError(
                 f"run payload {pp!r} unreadable: {e}") from None
-        if pst.st_size != PAY_HEADER_LEN + n * width:
+        want_pay = PAY_HEADER_LEN + n * width
+        if compressed:
+            want_pay += 8 * _runz_pay_blocks(n, block_elems)
+        if pst.st_size != want_pay:
             raise RunFormatError(
                 f"run payload {pp!r}: {pst.st_size} bytes on disk, "
-                f"expected {PAY_HEADER_LEN + n * width} "
+                f"expected {want_pay} "
                 f"({n} x {width}-byte payloads)")
         with open(pp, "rb") as f:
             phead = f.read(PAY_HEADER_LEN)
-        if phead[:8] != PAY_MAGIC or \
+        want_magic = PAY2_MAGIC if compressed else PAY_MAGIC
+        if phead[:8] != want_magic or \
                 int.from_bytes(phead[8:12], "little") != width:
             raise RunFormatError(
-                f"run payload {pp!r}: bad SORTPAY1 header")
+                f"run payload {pp!r}: bad "
+                f"{want_magic.decode('ascii')} header")
         _check_format_version(phead[PAY_VERSION_OFF], pp)
         disk += pst.st_size
-    return RunInfo(path, n, dtype, width, fp, disk)
+    return RunInfo(path, n, dtype, width, fp, disk,
+                   compressed=compressed)
 
 
 def read_run_chunks(info: RunInfo, chunk_elems: int):
     """Yield ``(keys_chunk, payload_chunk | None)`` slices of a run in
-    order — keys as zero-copy mmap slices (``kio.open_keys_mmap``, the
-    PR 2 page-in path), payload as mmap-backed ``(m, width)`` views.
+    order.  Raw runs: keys as zero-copy mmap slices
+    (``kio.open_keys_mmap``, the PR 2 page-in path), payload as
+    mmap-backed ``(m, width)`` views.  Compressed runs: sequential
+    block reads + decode (:mod:`store.compress`), any in-block
+    inconsistency raising the typed :class:`BlockIntegrityError`.
     Bounded memory at any run size."""
+    if info.compressed:
+        yield from _read_runz_chunks(info, chunk_elems)
+        return
     try:
         mm = kio.open_keys_mmap(info.path, info.dtype)
     except ValueError as e:
@@ -455,8 +705,106 @@ def read_run_chunks(info: RunInfo, chunk_elems: int):
     chunk_elems = max(1, int(chunk_elems))
     for i in range(0, info.n, chunk_elems):
         k = mm[i:i + chunk_elems]
+        throttle_disk(k.nbytes)
         p = pm[i:i + chunk_elems] if pm is not None else None
+        if p is not None:
+            throttle_disk(p.nbytes)
         yield k, p
+
+
+def _read_runz_chunks(info: RunInfo, chunk_elems: int):
+    """The compressed (SORTRUN2) half of :func:`read_run_chunks`:
+    stream block headers + bodies sequentially, validate EVERY framing
+    field against the sidecar's totals before trusting it, decode
+    (native engine when loadable), and compare the stored block
+    checksum against one folded from the reconstructed values.  Any
+    disagreement is a :class:`BlockIntegrityError` naming run + block
+    — the merge types it as run damage and re-spills."""
+    codec = codec_for(info.dtype)
+    chunk_elems = max(1, int(chunk_elems))
+    kf = open(info.path, "rb")
+    pf = open(info.pay_path, "rb") if info.payload_width else None
+    try:
+        head = kf.read(RUNZ_HEADER_LEN)
+        if len(head) < RUNZ_HEADER_LEN or head[:8] != RUNZ_MAGIC:
+            raise RunFormatError(
+                f"run file {info.path!r} is not SORTRUN2-framed")
+        block_elems = max(1, int.from_bytes(head[12:16], "little"))
+        if pf is not None:
+            pf.seek(PAY_HEADER_LEN)
+        remaining = info.n
+        bidx = 0
+        while remaining > 0:
+            bh = kf.read(RUNZ_BLOCK_HEADER_LEN)
+            if len(bh) != RUNZ_BLOCK_HEADER_LEN:
+                raise BlockIntegrityError(
+                    info.path, bidx, "truncated block header "
+                    f"({len(bh)} of {RUNZ_BLOCK_HEADER_LEN} bytes)")
+            bn = int.from_bytes(bh[0:4], "little")
+            bwidth = bh[4]
+            first = int.from_bytes(bh[8:16], "little")
+            plen = int.from_bytes(bh[16:20], "little")
+            stored = int.from_bytes(bh[20:24], "little")
+            if bn == 0 or bn > block_elems or bn > remaining:
+                raise BlockIntegrityError(
+                    info.path, bidx,
+                    f"element count {bn} outside 1..{min(block_elems, remaining)}")
+            if bwidth > 64:
+                raise BlockIntegrityError(
+                    info.path, bidx, f"delta width {bwidth} outside 0..64")
+            want = ((bn - 1) * bwidth + 7) // 8
+            if plen != want:
+                raise BlockIntegrityError(
+                    info.path, bidx,
+                    f"packed length {plen} disagrees with "
+                    f"(n={bn}, width={bwidth}) -> {want}")
+            packed = kf.read(plen)
+            if len(packed) != plen:
+                raise BlockIntegrityError(
+                    info.path, bidx, "truncated block body "
+                    f"({len(packed)} of {plen} bytes)")
+            throttle_disk(RUNZ_BLOCK_HEADER_LEN + plen)
+            try:
+                wide, chk = blockz.unpack_block(packed, bn, first, bwidth)
+            except ValueError as e:
+                raise BlockIntegrityError(info.path, bidx, str(e)) from None
+            if chk != stored:
+                raise BlockIntegrityError(
+                    info.path, bidx,
+                    f"checksum mismatch (stored {stored:#010x}, "
+                    f"re-folded {chk:#010x})")
+            keys = codec.decode(blockz.wide_to_words(wide, codec.n_words))
+            pay = None
+            if pf is not None:
+                pbh = pf.read(8)
+                if len(pbh) != 8:
+                    raise BlockIntegrityError(
+                        info.path, bidx, "truncated payload block header")
+                pn = int.from_bytes(pbh[0:4], "little")
+                pstored = int.from_bytes(pbh[4:8], "little")
+                if pn != bn:
+                    raise BlockIntegrityError(
+                        info.path, bidx,
+                        f"payload block holds {pn} records, key block {bn}")
+                pay_bytes = pf.read(bn * info.payload_width)
+                if len(pay_bytes) != bn * info.payload_width:
+                    raise BlockIntegrityError(
+                        info.path, bidx, "truncated payload block body")
+                throttle_disk(8 + len(pay_bytes))
+                if blockz.checksum_bytes(pay_bytes) != pstored:
+                    raise BlockIntegrityError(
+                        info.path, bidx, "payload block checksum mismatch")
+                pay = np.frombuffer(pay_bytes, np.uint8).reshape(
+                    bn, info.payload_width)
+            for i in range(0, bn, chunk_elems):
+                yield (keys[i:i + chunk_elems],
+                       pay[i:i + chunk_elems] if pay is not None else None)
+            remaining -= bn
+            bidx += 1
+    finally:
+        kf.close()
+        if pf is not None:
+            pf.close()
 
 
 class InputStage:
@@ -564,6 +912,13 @@ def run_body_views(info: RunInfo,
     to the socket without materializing the merged result.  With
     ``unlink`` the files are deleted now; the mmaps keep the bytes
     reachable until the views are dropped."""
+    if info.compressed:
+        # defensive: final/output runs are ALWAYS written raw (the wire
+        # layer serves their bodies verbatim) — a compressed run here
+        # means a routing bug upstream, not a servable reply
+        raise RunFormatError(
+            f"run file {info.path!r} is SORTRUN2-compressed; only raw "
+            "runs can serve zero-copy body views")
     mm = np.memmap(info.path, dtype=np.uint8, mode="r",
                    offset=kio.BIN_HEADER_LEN)
     views = [memoryview(mm)]

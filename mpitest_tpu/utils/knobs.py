@@ -715,6 +715,19 @@ register("SORT_SERVE_SPILL", "enum", "auto", "auto | off",
          "Route serve requests larger than SORT_SERVE_MAX_BYTES to the "
          "out-of-core spill tier instead of a typed 'bytes' rejection.",
          _enum("SORT_SERVE_SPILL", ("auto", "off")))
+register("SORT_SPILL_COMPRESS", "enum", "auto", "auto | on | off",
+         "Order-preserving compression of spill runs (SORTRUN2: delta + "
+         "bitpacked key blocks, raw payload blocks): 'auto' compresses "
+         "when native/libspillz.so loads, 'on' forces it (pure-Python "
+         "codec if the library is missing), 'off' writes raw runs.",
+         _enum("SORT_SPILL_COMPRESS", ("auto", "on", "off")))
+register("SORT_SPILL_THROTTLE_MBPS", "float", 0.0,
+         "a finite number >= 0 (0 = unthrottled)",
+         "Simulated spill-disk bandwidth cap in MB/s, shared across ALL "
+         "spill readers/writers in the process (one token bucket = one "
+         "disk) — makes disk-bound external sorts reproducible on fast "
+         "local storage for the spillperf gate.",
+         _float_ge0("SORT_SPILL_THROTTLE_MBPS"))
 
 # Crash-durable external sort (ISSUE 18: store/manifest.py) — journaled
 # spill manifests, kill-resume at the merge phase, and the age-gated

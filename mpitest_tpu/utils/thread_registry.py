@@ -157,6 +157,20 @@ THREAD_ROOTS: tuple[ThreadRoot, ...] = (
         "mpitest_tpu.utils.io._parse_text_block", False,
         "Text-ingest parse workers (iter_key_chunks): numpy/native "
         "parsing of file blocks; no device access."),
+    # -- external-sort async spill IO (ISSUE 20) ----------------------
+    ThreadRoot(
+        "spill-readahead", "thread",
+        "mpitest_tpu.store.aio.ReadAhead._worker", False,
+        "Per-run merge read-ahead: reads + decodes the NEXT spill "
+        "chunk (disk read, block decompression) while the merge "
+        "consumes the current one; host bytes/numpy only — the merge "
+        "loop owns any device work."),
+    ThreadRoot(
+        "spill-writebehind", "thread",
+        "mpitest_tpu.store.aio.WriteBehind._worker", False,
+        "Merge write-behind: drains output chunks into the "
+        "RunStreamWriter (encode, compress, throttle, write) behind "
+        "the emit loop; errors re-raise at the caller's next append."),
     # -- driver signals -----------------------------------------------
     ThreadRoot(
         "signal-drain", "signal",
@@ -277,9 +291,27 @@ LOCKS: tuple[LockDecl, ...] = (
              "Serializes the bounded topology subprocess probe and "
              "guards its cached verdict (TL004: written from main "
              "prewarm AND the tuner prewarm thread)."),
+    LockDecl("compress.load", 83,
+             "mpitest_tpu.store.compress._LOAD_LOCK",
+             "One-time spill-compression library resolution (same "
+             "double-checked shim shape as native.load)."),
+    LockDecl("runs.throttle", 84,
+             "mpitest_tpu.store.runs._THROTTLE_LOCK",
+             "The shared spill-disk token bucket "
+             "(SORT_SPILL_THROTTLE_MBPS): one bucket = one simulated "
+             "disk across every reader/writer thread; the sleep "
+             "happens OUTSIDE it (TL003)."),
     LockDecl("native.load", 85,
              "mpitest_tpu.utils.native_encode._LOAD_LOCK",
              "One-time native-library resolution."),
+    LockDecl("aio.readahead", 86,
+             "mpitest_tpu.store.aio.ReadAhead._lock",
+             "Read-ahead IO/stall interval stats — appended from the "
+             "worker AND the consuming merge thread (leaf)."),
+    LockDecl("aio.writebehind", 87,
+             "mpitest_tpu.store.aio.WriteBehind._lock",
+             "Write-behind interval stats + the parked worker error "
+             "re-raised at the caller's next append/close (leaf)."),
     LockDecl("ingest.stream", 88,
              "mpitest_tpu.models.ingest._StreamState.lock",
              "Streamed-ingest shared fold/stats state."),

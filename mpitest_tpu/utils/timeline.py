@@ -135,7 +135,7 @@ def build_timeline(spans: list[Any]) -> dict[str, Any]:
          "phases":   {phase: wall seconds},
          "critical_path_phase": dominant phase (None = no phase spans),
          "overlap":  {compute_s, dma_s, disk_s, compute_dma_pct,
-                      compute_disk_pct},
+                      compute_disk_pct, spill_disk_overlap},
          "counters": {"inflight_bytes": [(t, value)],
                       "exchange_cap":   [(t, cap)],
                       "cap_regrows":    [(t, cumulative)]}}
@@ -151,6 +151,7 @@ def build_timeline(spans: list[Any]) -> dict[str, Any]:
             by_id[(r.get("pid"), r["id"])] = r
 
     phases: dict[str, float] = {}
+    spill_overlap: float | None = None
     passes: list[dict] = []
     lanes: dict[int, list[dict]] = {}
     comp_iv: dict[Any, list] = {}
@@ -180,6 +181,13 @@ def build_timeline(spans: list[Any]) -> dict[str, Any]:
                 inflight.append((t0 + dt, -float(nbytes)))
         elif name in DISK_SPANS and dt > 0:
             disk_iv.setdefault(pid, []).append((t0, t0 + dt))
+            # the external sort's own measured read-ahead/write-behind
+            # concurrency (ISSUE 20) rides the FINAL merge span; older
+            # traces simply lack the attr (renders None, never 0)
+            if name == "external.merge" and attrs.get("final"):
+                ov = attrs.get("disk_overlap")
+                if isinstance(ov, (int, float)):
+                    spill_overlap = float(ov)
         elif name == BALANCE_SPAN:
             bytes_by_rank = _rank_bytes(attrs)
             stats = (straggler_stats(bytes_by_rank)
@@ -256,6 +264,9 @@ def build_timeline(spans: list[Any]) -> dict[str, Any]:
                                 if dma_s > 0 else 0.0),
             "compute_disk_pct": (round(100.0 * ov_disk / disk_s, 2)
                                  if disk_s > 0 else 0.0),
+            "spill_disk_overlap": (round(spill_overlap, 4)
+                                   if spill_overlap is not None
+                                   else None),
         },
         "counters": {"inflight_bytes": inflight_series,
                      "exchange_cap": cap_series,

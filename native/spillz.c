@@ -1,0 +1,164 @@
+/* spillz.c — the native spill-run block codec behind
+ * mpitest_tpu/store/compress.py.
+ *
+ * Pack is two tight passes: pass one streams the wrapping deltas to
+ * find the pack width while folding the checksum through registers;
+ * pass two bit-packs the deltas LSB-first.  The bit buffer never holds
+ * more than 39 live bits (deltas enter in <=32-bit slices after a
+ * byte-flush), so plain uint64 arithmetic suffices for width 64.
+ * Unpack is the mirror image with every read bounds-guarded — the
+ * decoder is the one kernel that eats raw disk bytes, so a torn or
+ * rotted block must fail loudly (SPZ_EBOUNDS / checksum mismatch) and
+ * never index past in[in_len).  Built as libspillz.so by bench/Makefile
+ * (`make -C bench libspillz`); -Wconversion -Wshadow -Werror clean
+ * (root cwarn-check), ASan/UBSan fuzzed via native/spillz_fuzz.c.
+ */
+#include "spillz.h"
+
+int spz_abi_version(void) { return SPZ_ABI_VERSION; }
+
+/* 32-bit fold of a uint64 value stream: each value is avalanche-mixed
+ * (the murmur3 finalizer) BEFORE the XOR and wrapping-sum accumulate,
+ * halves mixed down at the end.  The pre-mix matters: raw XOR+sum is
+ * blind to a 2^63 shift applied to an even-length suffix (bit-63 adds
+ * are carry-free, so the XOR flips cancel pairwise and the sum wraps
+ * to zero) — exactly the shape a single high packed-bit flip produces.
+ * Kept in a tiny struct so pack and unpack share the exact rule (and
+ * the numpy fallback mirrors it elementwise: m = mix64(vals),
+ * x = XOR-reduce(m), s = sum(m) mod 2^64,
+ * chk = (x ^ x>>32 ^ s ^ s>>32) & 0xFFFFFFFF). */
+typedef struct {
+    uint64_t x;
+    uint64_t s;
+} spz_fold;
+
+static uint64_t mix64(uint64_t z) {
+    z ^= z >> 33;
+    z *= 0xFF51AFD7ED558CCDULL;
+    z ^= z >> 33;
+    z *= 0xC4CEB9FE1A85EC53ULL;
+    z ^= z >> 33;
+    return z;
+}
+
+static void fold_step(spz_fold *f, uint64_t v) {
+    uint64_t m = mix64(v);
+    f->x ^= m;
+    f->s += m;
+}
+
+static uint32_t fold_final(const spz_fold *f) {
+    uint64_t m = f->x ^ (f->x >> 32) ^ f->s ^ (f->s >> 32);
+    return (uint32_t)(m & 0xFFFFFFFFu);
+}
+
+static int delta_width(uint64_t maxd) {
+    int w = 0;
+    while (maxd) {
+        w++;
+        maxd >>= 1;
+    }
+    return w;
+}
+
+static size_t packed_bytes(size_t n, int width) {
+    /* n >= 1: (n-1) deltas at width bits, zero-padded to whole bytes */
+    return ((n - 1) * (size_t)width + 7u) / 8u;
+}
+
+long long spz_pack_block(const uint64_t *vals, size_t n,
+                         unsigned char *out, size_t cap,
+                         uint64_t *first, int *width,
+                         uint32_t *checksum) {
+    spz_fold fold = {0, 0};
+    uint64_t maxd = 0;
+    size_t i, need, pos = 0;
+    uint64_t acc = 0;
+    unsigned nbits = 0;
+    int w;
+
+    if (n == 0)
+        return SPZ_EBOUNDS;
+    fold_step(&fold, (uint64_t)vals[0]);
+    for (i = 1; i < n; i++) {
+        uint64_t d = (uint64_t)vals[i] - (uint64_t)vals[i - 1];
+        if (d > maxd)
+            maxd = d;
+        fold_step(&fold, (uint64_t)vals[i]);
+    }
+    w = delta_width(maxd);
+    need = packed_bytes(n, w);
+    if (need > cap)
+        return SPZ_EBOUNDS;
+    for (i = 1; i < n; i++) {
+        uint64_t d = (uint64_t)vals[i] - (uint64_t)vals[i - 1];
+        unsigned rem = (unsigned)w;
+        while (rem > 0) {
+            /* flush first, then take <=32 bits: nbits <= 7 here, so
+             * the buffer tops out at 39 live bits — no 128-bit math */
+            unsigned take = rem > 32u ? 32u : rem;
+            uint64_t mask = (take == 64u) ? ~0ULL
+                                          : ((1ULL << take) - 1ULL);
+            acc |= (d & mask) << nbits;
+            nbits += take;
+            d >>= take;
+            rem -= take;
+            while (nbits >= 8u) {
+                out[pos++] = (unsigned char)(acc & 0xFFu);
+                acc >>= 8;
+                nbits -= 8u;
+            }
+        }
+    }
+    if (nbits > 0u)
+        out[pos++] = (unsigned char)(acc & 0xFFu);  /* zero-padded tail */
+    *first = (unsigned long long)vals[0];
+    *width = w;
+    *checksum = fold_final(&fold);
+    return (long long)pos;
+}
+
+long long spz_unpack_block(const unsigned char *in, size_t in_len,
+                           size_t n, uint64_t first,
+                           int width, uint64_t *vals_out,
+                           uint32_t *checksum_out) {
+    spz_fold fold = {0, 0};
+    uint64_t v = (uint64_t)first;
+    uint64_t acc = 0;
+    unsigned nbits = 0;
+    size_t i, pos = 0;
+
+    if (n == 0)
+        return SPZ_EBOUNDS;
+    if (width < 0 || width > 64)
+        return SPZ_EWIDTH;
+    if (in_len != packed_bytes(n, width))
+        return SPZ_EBOUNDS;
+    vals_out[0] = (unsigned long long)v;
+    fold_step(&fold, v);
+    for (i = 1; i < n; i++) {
+        uint64_t d = 0;
+        unsigned got = 0;
+        while (got < (unsigned)width) {
+            unsigned take;
+            if (nbits == 0u) {
+                if (pos >= in_len)
+                    return SPZ_EBOUNDS;  /* belt-and-braces: torn body */
+                acc = (uint64_t)in[pos++];
+                nbits = 8u;
+            }
+            take = (unsigned)width - got;
+            if (take > nbits)
+                take = nbits;
+            d |= (acc & ((1ULL << take) - 1ULL)) << got;
+            acc >>= take;
+            nbits -= take;
+            got += take;
+        }
+        v += d;  /* wrapping: the pack side's deltas are mod 2^64 */
+        vals_out[i] = (unsigned long long)v;
+        fold_step(&fold, v);
+    }
+    *checksum_out = fold_final(&fold);
+    return (long long)n;
+}

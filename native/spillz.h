@@ -1,0 +1,76 @@
+/* spillz.h — native spill-run compression: delta + fixed-width bitpack.
+ *
+ * The external sort's spill runs (mpitest_tpu/store/runs.py) are
+ * SORTED key words — the best-case input for delta coding: consecutive
+ * encoded keys differ by small non-negative amounts, so a block of
+ * 64-bit "wide" key values (the msw/lsw uint32 word planes combined,
+ * lexicographic order == numeric uint64 order) packs into
+ * `width = bit_length(max delta)` bits per key instead of 32/64.  The
+ * kernels here are the per-block codec: one pass computes the deltas,
+ * the width and the integrity checksum; a second pass bit-packs the
+ * deltas LSB-first (little-endian bit order — the exact layout of
+ * numpy's packbits(bitorder="little"), so the pure-Python fallback in
+ * mpitest_tpu/store/compress.py is bit-identical byte for byte).
+ * Deltas wrap mod 2^64, so ANY input block round-trips exactly —
+ * unsorted (corrupted-upstream) data costs width, never correctness.
+ *
+ * Exposed to Python via ctypes (mpitest_tpu/store/compress.py, knob
+ * SORT_SPILL_COMPRESS={auto,on,off}); ctypes releases the GIL around
+ * every call, so the read-ahead/write-behind threads of
+ * mpitest_tpu/store/aio.py decode/encode in real parallelism.  Parity
+ * contract: bit-identical packed bytes and checksums vs the fallback
+ * on every input — fuzzed (with ASan/UBSan in `make sanitize-selftest`)
+ * by native/spillz_fuzz.c, which also drives corrupt-block corpora
+ * through the decoder (it must fail loudly, never read out of
+ * bounds).  The symbol surface below is cross-checked against
+ * spillz.c by tools/comm_parity.py, like encode.h's.
+ */
+#ifndef SPILLZ_H
+#define SPILLZ_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+/* Status codes.  The ctypes shim maps each to the exception the
+ * pure-Python fallback raises for the same input (parity by TYPE):
+ * SPZ_EBOUNDS -> ValueError (length/capacity mismatch — a torn or
+ * garbage block body), SPZ_EWIDTH -> ValueError (header width > 64). */
+#define SPZ_OK       0
+#define SPZ_EBOUNDS (-1)  /* in/out length disagrees with (n, width) */
+#define SPZ_EWIDTH  (-2)  /* delta width outside 0..64 */
+
+/* ABI version stamp — the ctypes shim refuses a stale .so loudly
+ * instead of calling into a mismatched symbol surface. */
+#define SPZ_ABI_VERSION 1
+int spz_abi_version(void);
+
+/* Pack one block of n wide (uint64) key values into out[0..cap).
+ * Writes the block metadata the run framing stores in the block
+ * header: *first = vals[0], *width = bit_length(max wrapping delta)
+ * (0..64; 0 == constant block, zero packed bytes), *checksum = the
+ * 32-bit fold of the values (murmur3-finalizer mix per value, then
+ * XOR + wrapping sum, halves mixed — the pre-mix keeps high-bit
+ * corruption visible) the decoder re-derives.  Returns the packed byte
+ * count
+ * ceil((n-1)*width/8), or SPZ_EBOUNDS when cap is too small.
+ * n==0 is SPZ_EBOUNDS (the framing never writes empty blocks). */
+long long spz_pack_block(const uint64_t *vals, size_t n,
+                         unsigned char *out, size_t cap,
+                         uint64_t *first, int *width,
+                         uint32_t *checksum);
+
+/* Unpack one block: reconstruct n wide values into vals_out from the
+ * packed delta bytes in[0..in_len), given the block header's first
+ * value and delta width, and fold *checksum_out from the
+ * reconstructed values (the caller compares it against the stored
+ * block checksum — a mismatch is disk corruption, typed Python-side).
+ * Pre-checks in_len == ceil((n-1)*width/8) and bounds-guards every
+ * read, so garbage (n, width, in_len) combinations fail with
+ * SPZ_EBOUNDS/SPZ_EWIDTH instead of reading out of bounds.  Returns n
+ * or a negative status. */
+long long spz_unpack_block(const unsigned char *in, size_t in_len,
+                           size_t n, uint64_t first,
+                           int width, uint64_t *vals_out,
+                           uint32_t *checksum_out);
+
+#endif /* SPILLZ_H */

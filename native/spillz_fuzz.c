@@ -1,0 +1,233 @@
+/* spillz_fuzz.c — seeded, bounded fuzz driver over the native spill
+ * block codec (ISSUE 20 satellite).  Usage: spillz_fuzz <seed> <iters>.
+ *
+ * Three corpora per run, drawn from one splitmix64 stream so the SAME
+ * seed replays the SAME blocks in every build:
+ *
+ *  - roundtrip: random value blocks (sorted ramps, plateaus, raw
+ *    random — the wrapping-delta codec must be total) packed and
+ *    unpacked; the reconstruction must be exact, the checksums must
+ *    agree, and an independent naive scalar bit-gather re-decode of
+ *    the packed bytes must match the kernel's output bit for bit
+ *    (catches any bit-order/flush divergence);
+ *  - corrupt: a valid packed block with header fields and/or body
+ *    bytes scrambled; the decoder must either return a negative
+ *    status or a checksum that differs from the original — and under
+ *    ASan/UBSan it must never read out of bounds;
+ *  - garbage: wholly random (in_len, n, width) headers over random
+ *    bytes; any non-negative return must have consumed a
+ *    self-consistent length.
+ *
+ * Everything folds into one checksum printed at exit:
+ * `make sanitize-selftest` runs this under ASan+UBSan and as a plain
+ * build and requires identical output (the cross-build differential).
+ * Any internal inconsistency exits 1 immediately.
+ */
+#include <inttypes.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "spillz.h"
+
+static uint64_t sm_state;
+
+static uint64_t sm_next(void) {
+    uint64_t z = (sm_state += 0x9E3779B97F4A7C15ULL);
+    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+    z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+    return z ^ (z >> 31);
+}
+
+static uint64_t checksum;
+
+static void fold_u64(uint64_t v) {
+    checksum = (checksum ^ v) * 0x100000001B3ULL;  /* FNV-ish mix */
+}
+
+static void die(const char *what, uint64_t iter) {
+    fprintf(stderr, "spillz_fuzz: INVARIANT VIOLATION: %s (iter %" PRIu64
+            ")\n", what, iter);
+    exit(1);
+}
+
+#define MAX_N 2048
+
+/* independent naive re-decode: gather each delta bit by bit straight
+ * from the packed bytes (LSB-first), no shared helpers with the kernel */
+static void naive_unpack(const unsigned char *in, size_t n,
+                         uint64_t first, int width, uint64_t *out) {
+    uint64_t v = first;
+    out[0] = v;
+    for (size_t i = 1; i < n; i++) {
+        uint64_t d = 0;
+        for (int b = 0; b < width; b++) {
+            size_t bit = (i - 1) * (size_t)width + (size_t)b;
+            if ((in[bit / 8u] >> (bit % 8u)) & 1u)
+                d |= 1ULL << b;
+        }
+        v += d;
+        out[i] = v;
+    }
+}
+
+static void gen_block(uint64_t *vals, size_t n) {
+    uint64_t shape = sm_next() % 4u;
+    if (shape == 0) {                 /* sorted ramp, narrow deltas */
+        uint64_t v = sm_next();
+        uint64_t step = sm_next() % 1024u;
+        for (size_t i = 0; i < n; i++) {
+            vals[i] = v;
+            v += sm_next() % (step + 1u);
+        }
+    } else if (shape == 1) {          /* plateau: width-0 block */
+        uint64_t v = sm_next();
+        for (size_t i = 0; i < n; i++)
+            vals[i] = v;
+    } else if (shape == 2) {          /* sorted with rare wide jumps */
+        uint64_t v = sm_next();
+        for (size_t i = 0; i < n; i++) {
+            vals[i] = v;
+            v += (sm_next() % 64u == 0) ? sm_next() : sm_next() % 16u;
+        }
+    } else {                          /* raw random: wrapping totality */
+        for (size_t i = 0; i < n; i++)
+            vals[i] = sm_next();
+    }
+}
+
+static void fuzz_roundtrip(uint64_t iter) {
+    size_t n = (size_t)(sm_next() % MAX_N) + 1u;
+    uint64_t *vals = (uint64_t *)malloc(n * 8u);
+    uint64_t *back = (uint64_t *)malloc(n * 8u);
+    uint64_t *naive = (uint64_t *)malloc(n * 8u);
+    unsigned char *buf = (unsigned char *)malloc(n * 8u + 8u);
+    if (!vals || !back || !naive || !buf) die("malloc", iter);
+    gen_block(vals, n);
+    uint64_t first = 0;
+    int width = -1;
+    uint32_t chk = 0;
+    long long plen = spz_pack_block(vals, n, buf, n * 8u + 8u,
+                                    &first, &width, &chk);
+    if (plen < 0) die("pack rc", iter);
+    if (width < 0 || width > 64) die("pack width", iter);
+    if ((uint64_t)plen != ((n - 1) * (uint64_t)width + 7u) / 8u)
+        die("pack length", iter);
+    uint32_t chk2 = 0;
+    long long rn = spz_unpack_block(buf, (size_t)plen, n, first, width,
+                                    back, &chk2);
+    if (rn != (long long)n) die("unpack rc", iter);
+    if (chk2 != chk) die("checksum roundtrip", iter);
+    if (memcmp(back, vals, n * 8u) != 0) die("values roundtrip", iter);
+    naive_unpack(buf, n, first, width, naive);
+    if (memcmp(naive, vals, n * 8u) != 0) die("naive re-decode", iter);
+    /* short output capacity must be refused, never overrun */
+    if (plen > 0 && spz_pack_block(vals, n, buf, (size_t)plen - 1u,
+                                   &first, &width, &chk) != SPZ_EBOUNDS)
+        die("pack cap", iter);
+    fold_u64((uint64_t)plen ^ ((uint64_t)chk << 32) ^ (uint64_t)width);
+    for (long long i = 0; i < plen; i += 31)
+        fold_u64((uint64_t)buf[i]);
+    free(vals); free(back); free(naive); free(buf);
+}
+
+static void fuzz_corrupt(uint64_t iter) {
+    size_t n = (size_t)(sm_next() % 256u) + 2u;
+    uint64_t *vals = (uint64_t *)malloc(n * 8u);
+    uint64_t *back = (uint64_t *)malloc(n * 8u);
+    unsigned char *buf = (unsigned char *)malloc(n * 8u + 8u);
+    if (!vals || !back || !buf) die("malloc", iter);
+    gen_block(vals, n);
+    uint64_t first = 0;
+    int width = 0;
+    uint32_t chk = 0;
+    long long plen = spz_pack_block(vals, n, buf, n * 8u + 8u,
+                                    &first, &width, &chk);
+    if (plen < 0) die("pack rc (corrupt leg)", iter);
+    /* scramble: body byte flips, a lying first value, a lying width —
+     * the decoder must fail the length pre-check, hit the bounds
+     * guard, or surface a checksum that no longer matches */
+    uint64_t bad_first = first;
+    int bad_width = width;
+    size_t bad_len = (size_t)plen;
+    uint64_t nbits = (uint64_t)(n - 1) * (uint64_t)width;
+    switch (sm_next() % 3u) {
+    case 0:
+        if (nbits > 0) {
+            /* flip a MEANINGFUL packed bit (never the zero-padding
+             * tail, which the decoder rightly ignores) */
+            uint64_t bit = sm_next() % nbits;
+            buf[bit / 8u] ^= (unsigned char)(1u << (bit % 8u));
+        } else {
+            bad_first ^= sm_next() | 1u;  /* width-0 block: lie about
+                                           * the only stored value */
+        }
+        break;
+    case 1:
+        bad_first ^= sm_next() | 1u;
+        break;
+    default:
+        bad_width = (int)(sm_next() % 80u);  /* may exceed 64 */
+        if (bad_width == width)
+            bad_width = width ? 0 : 65;
+        break;
+    }
+    uint32_t chk2 = 0;
+    long long rn = spz_unpack_block(buf, bad_len, n, bad_first,
+                                    bad_width, back, &chk2);
+    if (rn >= 0 && bad_width == width && bad_first == first &&
+        chk2 == chk) {
+        /* every corruption above changes bytes/fields the checksum or
+         * the length pre-check covers; silent agreement is a miss */
+        die("corruption went undetected", iter);
+    }
+    fold_u64((uint64_t)(rn < 0 ? -rn : rn) ^ ((uint64_t)chk2 << 16));
+    free(vals); free(back); free(buf);
+}
+
+static void fuzz_garbage(uint64_t iter) {
+    size_t blen = (size_t)(sm_next() % 512u);
+    size_t n = (size_t)(sm_next() % 300u);
+    int width = (int)(sm_next() % 80u);
+    unsigned char *buf = (unsigned char *)malloc(blen ? blen : 1u);
+    uint64_t *out = (uint64_t *)malloc((n ? n : 1u) * 8u);
+    if (!buf || !out) die("malloc", iter);
+    for (size_t i = 0; i < blen; i++)
+        buf[i] = (unsigned char)sm_next();
+    uint32_t chk = 0;
+    long long rn = spz_unpack_block(buf, blen, n,
+                                    sm_next(),
+                                    width, out, &chk);
+    if (rn >= 0) {
+        if ((size_t)rn != n || n == 0) die("garbage rc shape", iter);
+        if (blen != ((n - 1) * (uint64_t)width + 7u) / 8u)
+            die("garbage accepted bad length", iter);
+    }
+    fold_u64((uint64_t)(rn < 0 ? -rn : rn) ^ (uint64_t)chk);
+    free(buf); free(out);
+}
+
+int main(int argc, char **argv) {
+    if (argc != 3) {
+        fprintf(stderr, "Usage: %s <seed> <iters>\n", argv[0]);
+        return 2;
+    }
+    uint64_t seed = (uint64_t)strtoull(argv[1], NULL, 10);
+    uint64_t iters = (uint64_t)strtoull(argv[2], NULL, 10);
+    sm_state = seed;
+    checksum = 0xCBF29CE484222325ULL;
+    if (spz_abi_version() != SPZ_ABI_VERSION) {
+        fprintf(stderr, "spillz_fuzz: ABI mismatch\n");
+        return 1;
+    }
+    for (uint64_t i = 0; i < iters; i++) {
+        switch (sm_next() % 3u) {
+        case 0: fuzz_roundtrip(i); break;
+        case 1: fuzz_corrupt(i); break;
+        default: fuzz_garbage(i); break;
+        }
+    }
+    printf("spillz_fuzz seed=%" PRIu64 " iters=%" PRIu64
+           " checksum=%016" PRIx64 "\n", seed, iters, checksum);
+    return 0;
+}

@@ -6,13 +6,14 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 
 import numpy as np
 import pytest
 
 from mpitest_tpu.models import records
 from mpitest_tpu.models.supervisor import SortIntegrityError
-from mpitest_tpu.store import external
+from mpitest_tpu.store import aio, external
 from mpitest_tpu.store import merge as mergelib
 from mpitest_tpu.store import runs as runlib
 from mpitest_tpu.utils import knobs
@@ -76,8 +77,11 @@ def test_run_roundtrip_with_payload(tmp_path, rng):
 
 
 def test_truncated_run_is_typed(tmp_path, rng):
+    # raw-framing drill: pin compress=False so the open-time body-size
+    # check (raw-specific; compressed damage types at READ time as
+    # BlockIntegrityError instead) is what trips
     keys = np.sort(_keys(rng, np.int32, 1000))
-    info = runlib.write_run(str(tmp_path), "t", keys)
+    info = runlib.write_run(str(tmp_path), "t", keys, compress=False)
     with open(info.path, "r+b") as f:   # sortlint: disable=SL014 -- the test IS the corruption drill
         f.truncate(os.path.getsize(info.path) - 8)
     with pytest.raises(runlib.RunFormatError, match="truncated|bytes"):
@@ -94,8 +98,10 @@ def test_garbage_sidecar_is_typed(tmp_path, rng):
 
 
 def test_corrupt_run_fails_verify_and_merge(tmp_path, rng):
+    # raw-framing drill (fold-vs-sidecar blame); the compressed twin
+    # lives in the SORTRUN2 tests below
     keys = np.sort(_keys(rng, np.int32, 4000))
-    info = runlib.write_run(str(tmp_path), "c", keys)
+    info = runlib.write_run(str(tmp_path), "c", keys, compress=False)
     with open(info.path, "r+b") as f:  # sortlint: disable=SL014 -- corruption drill
         f.seek(runlib.kio.BIN_HEADER_LEN + 40)
         f.write(b"\xff\xff\xff\xfe")
@@ -541,7 +547,8 @@ def test_gc_reclaims_orphans_age_gated(tmp_path, rng):
     assert external.gc_spill_dir(str(tmp_path), age_s=3600) == 3
     left = sorted(os.listdir(tmp_path))
     # manifest-referenced files and the journal survive; orphans die
-    assert "live_00000.run" in left and "liveds.mfst" in left
+    # (suffix-agnostic: the run may be .run or .runz per the knob)
+    assert os.path.basename(live.path) in left and "liveds.mfst" in left
     assert not any(f.startswith(("orphan", "stray")) for f in left)
 
 
@@ -570,4 +577,277 @@ def test_external_knob_validation():
         with pytest.raises(ValueError, match="SORT_FAULT_ENOSPC_AT"):
             knobs.get("SORT_FAULT_ENOSPC_AT")
     assert knobs.get("SORT_RESUME") == "auto"
+
+
+def test_spill_compress_knob_validation():
+    # ISSUE 20 knobs
+    with knobs.scoped_env(SORT_SPILL_COMPRESS="zstd"):
+        with pytest.raises(ValueError, match="SORT_SPILL_COMPRESS"):
+            knobs.get("SORT_SPILL_COMPRESS")
+    with knobs.scoped_env(SORT_SPILL_THROTTLE_MBPS="-2"):
+        with pytest.raises(ValueError, match="SORT_SPILL_THROTTLE_MBPS"):
+            knobs.get("SORT_SPILL_THROTTLE_MBPS")
+    with knobs.scoped_env(SORT_SPILL_THROTTLE_MBPS="inf"):
+        with pytest.raises(ValueError, match="SORT_SPILL_THROTTLE_MBPS"):
+            knobs.get("SORT_SPILL_THROTTLE_MBPS")
+    assert knobs.get("SORT_SPILL_COMPRESS") == "auto"
+    assert knobs.get("SORT_SPILL_THROTTLE_MBPS") == 0.0
+
+
+# -------------------------- spill compression + async IO (ISSUE 20)
+
+@pytest.mark.parametrize("dtype", ALL_DTYPES)
+def test_compressed_run_roundtrip_all_dtypes(tmp_path, rng, dtype):
+    keys = np.sort(_keys(rng, dtype, 5000))
+    info = runlib.write_run(str(tmp_path), f"z_{dtype}", keys,
+                            compress=True)
+    assert info.compressed and info.path.endswith(".runz")
+    ri = runlib.open_run(info.path)
+    assert ri.compressed and ri.n == 5000
+    assert ri.fingerprint == info.fingerprint
+    back = np.concatenate([np.array(k) for k, _p in
+                           runlib.read_run_chunks(ri, 700)])
+    assert np.array_equal(back, keys)
+    assert runlib.verify_run(ri, chunk_elems=512)
+
+
+def test_compressed_run_roundtrip_with_payload(tmp_path, rng):
+    n = 3000
+    keys = _keys(rng, np.int64, n)
+    pay = rng.integers(0, 256, (n, 5), dtype=np.uint8)
+    order = np.argsort(keys, kind="stable")
+    info = runlib.write_run(str(tmp_path), "zp", keys[order],
+                            pay[order], compress=True)
+    assert info.compressed and info.payload_width == 5
+    ri = runlib.open_run(info.path)
+    ks, ps = [], []
+    for k, p in runlib.read_run_chunks(ri, 999):
+        ks.append(np.array(k))
+        ps.append(np.array(p))
+    assert np.array_equal(np.concatenate(ks), keys[order])
+    assert np.array_equal(np.concatenate(ps), pay[order])
+    assert runlib.verify_run(ri)
+
+
+def test_mixed_raw_and_compressed_runs_merge(tmp_path, rng):
+    """Readers dispatch on the file magic, so one merge can consume
+    raw (.run) and compressed (.runz) inputs together — the exact
+    shape a SORT_SPILL_COMPRESS flip mid-fleet leaves behind."""
+    arrays = [rng.integers(-10**6, 10**6, 3000, dtype=np.int32)
+              for _ in range(4)]
+    infos = [runlib.write_run(str(tmp_path), f"mix{i}", np.sort(a),
+                              compress=(i % 2 == 0))
+             for i, a in enumerate(arrays)]
+    assert {i.compressed for i in infos} == {True, False}
+    got, _ = _merge_to_array(infos, chunk=61)
+    assert np.array_equal(got, np.sort(np.concatenate(arrays)))
+
+
+def test_codec_engines_bit_identical(rng):
+    """Bytes on disk are engine-independent: the native kernels and
+    the pure-Python fallback must produce IDENTICAL packed blocks and
+    checksums (cross-decode included), or a .runz written on one image
+    would type as corrupt on another."""
+    from mpitest_tpu.store import compress as blockz
+
+    cases = [
+        np.sort(rng.integers(0, 2**63, 4096, dtype=np.uint64)),
+        np.sort(rng.integers(0, 2**20, 1000, dtype=np.uint64)),
+        np.zeros(7, dtype=np.uint64),             # width-0 block
+        np.array([5], dtype=np.uint64),           # single element
+        np.array([0, 2**64 - 1], dtype=np.uint64),  # width-64 delta
+    ]
+    for vals in cases:
+        py = blockz.pack_block(vals, eng="python")
+        if blockz.available():
+            nat = blockz.pack_block(vals, eng="native")
+            assert nat == py
+        packed, first, width, chk = py
+        for eng in ("python", "native") if blockz.available() \
+                else ("python",):
+            out, chk2 = blockz.unpack_block(packed, vals.size, first,
+                                            width, eng=eng)
+            assert np.array_equal(out, vals) and chk2 == chk
+
+
+def test_compressed_block_garbage_is_typed(tmp_path, rng):
+    """Open stays header-only; the damage types at READ time as
+    BlockIntegrityError naming run + block, and the merge layer
+    translates it to RunIntegrityError so blame-respill recovery
+    covers compressed corruption too."""
+    keys = np.sort(_keys(rng, np.int32, 20_000))   # several blocks
+    info = runlib.write_run(str(tmp_path), "zc", keys, compress=True)
+    # flip a byte of block 0's `first` field: guaranteed checksum
+    # mismatch regardless of how the deltas land
+    off = runlib.RUNZ_HEADER_LEN + 8
+    with open(info.path, "r+b") as f:  # sortlint: disable=SL014 -- corruption drill
+        f.seek(off)
+        b = f.read(1)
+        f.seek(off)
+        f.write(bytes([b[0] ^ 0xFF]))
+    ri = runlib.open_run(info.path)    # header-only: still opens
+    with pytest.raises(runlib.BlockIntegrityError) as ei:
+        for _ in runlib.read_run_chunks(ri, 700):
+            pass
+    assert ei.value.block == 0 and ei.value.path == info.path
+    # verify_run surfaces the typed error (BlockIntegrityError IS a
+    # RunFormatError — the driver's blame step catches that supertype
+    # and treats it as "bad run, re-spill")
+    with pytest.raises(runlib.RunFormatError):
+        runlib.verify_run(ri)
+    with pytest.raises(mergelib.RunIntegrityError):
+        for _ in mergelib.merge_runs([ri], 512):
+            pass
+
+
+def test_crash_resume_over_compressed_runs(tmp_path, rng):
+    """The ISSUE 18 all-committed resume shape over .runz journals:
+    re-enter at the merge phase with ZERO re-sorted chunks."""
+    from mpitest_tpu.utils.trace import Tracer
+
+    budget = 1 << 15
+    x = _keys(rng, np.int32, 30_000)
+    with knobs.scoped_env(SORT_SPILL_COMPRESS="on"):
+        chunk = external.spill_chunk_elems(budget, x.dtype, 0)
+        nchunks = -(-x.size // chunk)
+        _, mpath, infos = _plant_crash_state(tmp_path, x, budget, "dz",
+                                             list(range(nchunks)))
+        assert all(i.path.endswith(".runz") for i in infos.values())
+        tr = Tracer()
+        res = external.external_sort(x, budget=budget,
+                                     spill_dir=str(tmp_path),
+                                     dataset="dz", tracer=tr)
+    assert np.array_equal(res.keys, np.sort(x))
+    assert res.resumed_runs == nchunks
+    assert _external_span_counts(tr).get("external.run", 0) == 0
+    assert not os.path.exists(mpath)
+
+
+def test_subtract_intervals():
+    sub = aio.subtract_intervals
+    assert sub((0.0, 10.0), []) == [(0.0, 10.0)]
+    assert sub((0.0, 10.0), [(2.0, 3.0), (5.0, 7.0)]) == \
+        [(0.0, 2.0), (3.0, 5.0), (7.0, 10.0)]
+    assert sub((0.0, 10.0), [(0.0, 10.0)]) == []
+    assert sub((2.0, 4.0), [(0.0, 1.0), (5.0, 6.0)]) == [(2.0, 4.0)]
+    assert sub((2.0, 4.0), [(0.0, 3.0)]) == [(3.0, 4.0)]
+    assert sub((2.0, 4.0), [(3.0, 9.0)]) == [(2.0, 3.0)]
+
+
+def test_readahead_matches_sync_and_is_bounded(tmp_path, rng):
+    keys = np.sort(_keys(rng, np.int32, 50_000))
+    info = runlib.write_run(str(tmp_path), "ra", keys, compress=True)
+    sync = [np.array(k) for k, _p in
+            runlib.read_run_chunks(runlib.open_run(info.path), 1000)]
+    ra = aio.ReadAhead(runlib.open_run(info.path), 1000)
+    try:
+        # bounded double buffering: with the consumer idle, the
+        # producer parks at the queue cap instead of decoding the
+        # whole run into memory
+        deadline = time.monotonic() + 5.0
+        while ra._q.qsize() < aio.QUEUE_DEPTH and \
+                time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert ra._q.qsize() <= aio.QUEUE_DEPTH
+        got = [np.array(k) for k, _p in ra]
+    finally:
+        ra.close()
+    assert len(got) == len(sync)
+    assert all(np.array_equal(a, b) for a, b in zip(got, sync))
+    io_iv, _stalls = ra.snapshot()
+    assert len(io_iv) == len(sync) and all(b >= a for a, b in io_iv)
+
+
+def test_readahead_close_midstream_joins(tmp_path, rng):
+    keys = np.sort(_keys(rng, np.int32, 50_000))
+    info = runlib.write_run(str(tmp_path), "rc", keys)
+    ra = aio.ReadAhead(runlib.open_run(info.path), 500)
+    next(ra)
+    ra.close()
+    ra.close()   # idempotent
+    assert not ra._thread.is_alive()
+    with pytest.raises(StopIteration):
+        next(ra)
+
+
+def test_readahead_propagates_block_corruption(tmp_path, rng):
+    """The worker thread's typed exception surfaces at the consumer's
+    next() with the original type — same contract as the sync path."""
+    keys = np.sort(_keys(rng, np.int32, 20_000))
+    info = runlib.write_run(str(tmp_path), "rx", keys, compress=True)
+    off = runlib.RUNZ_HEADER_LEN + 8
+    with open(info.path, "r+b") as f:  # sortlint: disable=SL014 -- corruption drill
+        f.seek(off)
+        b = f.read(1)
+        f.seek(off)
+        f.write(bytes([b[0] ^ 0xFF]))
+    ra = aio.ReadAhead(runlib.open_run(info.path), 700)
+    try:
+        with pytest.raises(runlib.BlockIntegrityError):
+            for _ in ra:
+                pass
+    finally:
+        ra.close()
+
+
+def test_writebehind_writes_identical_run(tmp_path, rng):
+    keys = np.sort(_keys(rng, np.int32, 20_000))
+    w = runlib.RunStreamWriter(str(tmp_path), "wb", keys.dtype, 0,
+                               compress=True)
+    wb = aio.WriteBehind(w)
+    for i in range(0, keys.size, 3000):
+        wb.append(keys[i:i + 3000])
+    info = wb.close()
+    ri = runlib.open_run(info.path)
+    back = np.concatenate([np.array(k) for k, _p in
+                           runlib.read_run_chunks(ri, 777)])
+    assert np.array_equal(back, keys)
+    assert runlib.verify_run(ri)
+
+
+def test_writebehind_reraises_writer_error(tmp_path):
+    class BoomWriter:
+        aborted = False
+
+        def append(self, keys, payload=None):
+            raise OSError(28, "disk full (drill)")
+
+        def append_words(self, kw, pw):
+            raise OSError(28, "disk full (drill)")
+
+        def abort(self):
+            self.aborted = True
+
+    boom = BoomWriter()
+    wb = aio.WriteBehind(boom)
+    wb.append(np.arange(3, dtype=np.int32))
+    # the worker parks the error and sets abort; wait for it, then the
+    # NEXT append must re-raise the ORIGINAL exception type
+    deadline = time.monotonic() + 5.0
+    while not wb._abort.is_set() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    with pytest.raises(OSError, match="disk full"):
+        wb.append(np.arange(3, dtype=np.int32))
+    wb.abort()
+    assert boom.aborted and not wb._thread.is_alive()
+
+
+def test_merge_with_async_io_bit_identical(tmp_path, rng):
+    arrays = [rng.integers(-10**6, 10**6, 5000, dtype=np.int32)
+              for _ in range(5)]
+    infos = [runlib.write_run(str(tmp_path), f"aio{i}", np.sort(a),
+                              compress=(i % 2 == 0))
+             for i, a in enumerate(arrays)]
+    io = aio.MergeIO()
+    codec = runlib.codec_for(infos[0].dtype)
+    t0 = time.perf_counter()
+    parts = [codec.decode(kws)
+             for kws, _p in mergelib.merge_runs(infos, 611, io=io)]
+    stats = io.stats(t0, time.perf_counter())
+    assert np.array_equal(np.concatenate(parts),
+                          np.sort(np.concatenate(arrays)))
+    assert 0.0 <= stats["disk_overlap"] <= 1.0
+    assert stats["disk_busy_s"] >= 0.0 and stats["overlap_s"] >= 0.0
+    # merge_runs' cursor cleanup closed every reader thread
+    assert all(not ra._thread.is_alive() for ra in io.readers)
     assert knobs.get("SORT_SPILL_GC_AGE_S") == 3600

@@ -68,6 +68,12 @@ LABEL_COLUMNS: tuple[tuple[str, str], ...] = (
     # (max/median rank bytes, the 8dev row's value when present) —
     # rendered as a ratio string, no regression math, pre-r06 "-".
     ("straggler", "straggler"),
+    # ISSUE 20: the external row's spill compression ratio (logical /
+    # spilled bytes) and measured final-merge disk/compute overlap —
+    # rendered verbatim (pre-r06 rounds, and rounds predating the
+    # fields, render "-"; no regression math).
+    ("spill_ratio", "spill ratio"),
+    ("disk_overlap", "disk ov"),
 )
 
 _RUN_RE = re.compile(r"BENCH_r(\d+)\.json$")
@@ -136,6 +142,14 @@ def load_run(path: Path) -> dict[str, object]:
                 # ISSUE 15: the out-of-core row — never folded into
                 # the in-memory sort column
                 put("external_mkeys_per_s", obj["value"])
+                # ISSUE 20: compression + IO-overlap labels (rows
+                # predating the fields render "-")
+                sr = obj.get("spill_ratio")
+                if isinstance(sr, (int, float)):
+                    labels["spill_ratio"] = f"{sr:g}x"
+                do = obj.get("disk_overlap")
+                if isinstance(do, (int, float)):
+                    labels["disk_overlap"] = f"{100 * do:.0f}%"
             else:
                 put("sort_row_mkeys_per_s", obj["value"])
                 if "plan_regret" not in vals:

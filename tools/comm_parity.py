@@ -43,6 +43,12 @@ _DECL_RE = re.compile(r"^\s*(?:int|void|double)\s+(comm_\w+)\s*\(",
 _ENC_DECL_RE = re.compile(
     r"^\s*(?:int|void|long long|size_t)\s+(enc_\w+)\s*\(", re.MULTILINE)
 
+#: native/spillz.h symbols (the spill-compression surface, ISSUE 20):
+#: same contract as encode.h — every declared spz_* function must be
+#: defined in spillz.c or store/compress.py's _bind() dies at load.
+_SPZ_DECL_RE = re.compile(
+    r"^\s*(?:int|void|long long|size_t)\s+(spz_\w+)\s*\(", re.MULTILINE)
+
 
 #: A function DEFINITION: return type + name + ( ... with no trailing ';'
 #: on the prototype line run (brace may sit on a later line).
@@ -257,6 +263,23 @@ def main() -> int:
         errors.append(f"native/encode.c: defines {sym} which encode.h "
                       "does not declare (shim-invisible API surface)")
 
+    # Spill-compression surface (ISSUE 20): spillz.h vs spillz.c, same
+    # both-directions check as the encode unit.
+    spz_h = (REPO / "native" / "spillz.h").read_text()
+    spz_declared = sorted(set(_SPZ_DECL_RE.findall(spz_h)))
+    if not spz_declared:
+        errors.append("native/spillz.h: no spz_* declarations parsed")
+    spz_defined = _defined_symbols(
+        _strip_comments((REPO / "native" / "spillz.c").read_text()),
+        pattern=r"spz_\w+")
+    for sym in spz_declared:
+        if sym not in spz_defined:
+            errors.append(f"native/spillz.c: declared symbol {sym} has "
+                          "no definition")
+    for sym in sorted(spz_defined - set(spz_declared)):
+        errors.append(f"native/spillz.c: defines {sym} which spillz.h "
+                      "does not declare (shim-invisible API surface)")
+
     # Blocking-under-mutex (threadlint TL003's C-side twin) over both
     # backends — the stats mutex must never pend on a peer.
     for backend in ("comm/comm_local.c", "comm/comm_mpi.c"):
@@ -285,7 +308,8 @@ def main() -> int:
     print(f"comm parity: {len(errors)} mismatch(es); "
           f"{len(declared)} comm.h symbols x {len(backends)} backends, "
           f"{len(called)} MPI calls x 2 runtimes, "
-          f"{len(enc_declared)} encode.h symbols checked")
+          f"{len(enc_declared)} encode.h + {len(spz_declared)} "
+          "spillz.h symbols checked")
     return 1 if errors else 0
 
 

@@ -661,7 +661,8 @@ _MANIFEST_HOME = "mpitest_tpu/store/manifest.py"
 #: File-name suffixes that identify a spill artifact (the run format's
 #: whole on-disk surface: keys, payload, sidecar, wire staging, and
 #: the ISSUE 18 manifest journal).
-_RUN_SUFFIXES = (".run", ".pay", ".fpr.json", ".spill", ".mfst")
+_RUN_SUFFIXES = (".run", ".runz", ".pay", ".fpr.json", ".spill",
+                 ".mfst")
 
 #: RunInfo path accessors — passing one to open()/np.memmap is the
 #: other ad-hoc bypass shape.
